@@ -1,0 +1,27 @@
+"""deeplearning4j_tpu — a TPU-native deep-learning framework.
+
+A from-scratch reimplementation of the *capabilities* of Deeplearning4j
+(reference surveyed in SURVEY.md) designed idiomatically for TPUs:
+
+- declarative layer/graph configuration DSL with JSON round-trip
+  (reference: deeplearning4j-nn/.../nn/conf/NeuralNetConfiguration.java)
+- pure-functional layer forward passes compiled by XLA; gradients via
+  autodiff instead of hand-written backprop
+  (reference: deeplearning4j-nn/.../nn/layers/*)
+- one jitted train step = forward + loss + grad + normalization + fused
+  updater, with buffer donation
+  (reference: Solver/StochasticGradientDescent + BaseMultiLayerUpdater)
+- data parallelism via jax.sharding Mesh + per-step gradient psum over ICI
+  (reference: deeplearning4j-scaleout ParallelWrapper / Spark averaging)
+- Pallas kernels where XLA's defaults need help
+  (reference: deeplearning4j-cuda cuDNN helper plugins)
+
+The public API deliberately mirrors the reference's concept names
+(MultiLayerConfiguration, ComputationGraph, Updater, Evaluation, ...) so a
+DL4J user can find everything they know, while the execution model is
+TPU-first throughout.
+"""
+
+__version__ = "0.1.0"
+
+from deeplearning4j_tpu.common.dtypes import PrecisionPolicy, default_policy

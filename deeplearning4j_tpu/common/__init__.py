@@ -1,0 +1,1 @@
+"""Common utilities: precision policy, registries, pytree helpers."""

@@ -1,0 +1,6 @@
+"""Core NN engine: configs, layers, networks.
+
+Analog of the reference's deeplearning4j-nn module (~64k LoC Java), rebuilt
+as: declarative config dataclasses -> pure functional layer forwards ->
+XLA-compiled networks. See SURVEY.md §2.1.
+"""

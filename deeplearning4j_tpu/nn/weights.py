@@ -1,0 +1,122 @@
+"""Weight initialization schemes.
+
+Mirrors the reference's WeightInit enum + WeightInitUtil
+(deeplearning4j-nn/.../nn/weights/WeightInit.java, WeightInitUtil.java) and
+the distribution configs (nn/conf/distribution/). Same math, but drawn with
+JAX's counter-based threefry PRNG so initialization is reproducible per-seed
+and per-parameter regardless of device count or evaluation order — a
+property the reference's sequential java.util.Random stream cannot give.
+
+fan_in / fan_out follow the reference's convention: for a dense kernel
+[nIn, nOut] fan_in=nIn, fan_out=nOut; for conv kernels
+[kh, kw, cin, cout] fan_in = cin*kh*kw, fan_out = cout*kh*kw
+(WeightInitUtil.initWeights receives fanIn/fanOut computed that way by each
+ParamInitializer).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+class WeightInit:
+    ZERO = "zero"
+    ONES = "ones"
+    UNIFORM = "uniform"
+    XAVIER = "xavier"
+    XAVIER_UNIFORM = "xavier_uniform"
+    XAVIER_FAN_IN = "xavier_fan_in"
+    XAVIER_LEGACY = "xavier_legacy"
+    RELU = "relu"
+    RELU_UNIFORM = "relu_uniform"
+    SIGMOID_UNIFORM = "sigmoid_uniform"
+    LECUN_NORMAL = "lecun_normal"
+    LECUN_UNIFORM = "lecun_uniform"
+    NORMAL = "normal"
+    DISTRIBUTION = "distribution"
+    IDENTITY = "identity"
+
+
+def init_weights(
+    key: jax.Array,
+    shape: Sequence[int],
+    fan_in: float,
+    fan_out: float,
+    scheme: str = WeightInit.XAVIER,
+    distribution: Optional[dict] = None,
+    dtype=jnp.float32,
+) -> jax.Array:
+    """Draw one weight tensor. `distribution` is the serialized distribution
+    config used by scheme == DISTRIBUTION, e.g. {"type": "normal",
+    "mean": 0, "std": 0.01} (reference: nn/conf/distribution/*)."""
+    shape = tuple(int(s) for s in shape)
+    s = scheme.lower()
+    if s == WeightInit.ZERO:
+        return jnp.zeros(shape, dtype)
+    if s == WeightInit.ONES:
+        return jnp.ones(shape, dtype)
+    if s == WeightInit.IDENTITY:
+        if len(shape) != 2 or shape[0] != shape[1]:
+            raise ValueError(f"IDENTITY init needs a square 2d shape, got {shape}")
+        return jnp.eye(shape[0], dtype=dtype)
+    if s == WeightInit.UNIFORM:
+        a = 1.0 / math.sqrt(fan_in)
+        return jax.random.uniform(key, shape, dtype, minval=-a, maxval=a)
+    if s == WeightInit.XAVIER:
+        std = math.sqrt(2.0 / (fan_in + fan_out))
+        return std * jax.random.normal(key, shape, dtype)
+    if s == WeightInit.XAVIER_UNIFORM:
+        a = math.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(key, shape, dtype, minval=-a, maxval=a)
+    if s == WeightInit.XAVIER_FAN_IN:
+        std = math.sqrt(1.0 / fan_in)
+        return std * jax.random.normal(key, shape, dtype)
+    if s == WeightInit.XAVIER_LEGACY:
+        std = math.sqrt(1.0 / (shape[0] * shape[-1]))
+        return std * jax.random.normal(key, shape, dtype)
+    if s == WeightInit.RELU:
+        std = math.sqrt(2.0 / fan_in)
+        return std * jax.random.normal(key, shape, dtype)
+    if s == WeightInit.RELU_UNIFORM:
+        a = math.sqrt(6.0 / fan_in)
+        return jax.random.uniform(key, shape, dtype, minval=-a, maxval=a)
+    if s == WeightInit.SIGMOID_UNIFORM:
+        a = 4.0 * math.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(key, shape, dtype, minval=-a, maxval=a)
+    if s == WeightInit.LECUN_NORMAL:
+        std = math.sqrt(1.0 / fan_in)
+        return std * jax.random.normal(key, shape, dtype)
+    if s == WeightInit.LECUN_UNIFORM:
+        a = math.sqrt(3.0 / fan_in)
+        return jax.random.uniform(key, shape, dtype, minval=-a, maxval=a)
+    if s == WeightInit.NORMAL:
+        return jax.random.normal(key, shape, dtype) / math.sqrt(fan_in)
+    if s == WeightInit.DISTRIBUTION:
+        return _from_distribution(key, shape, distribution or {}, dtype)
+    raise ValueError(f"unknown weight init scheme {scheme!r}")
+
+
+def _from_distribution(key, shape, dist: dict, dtype):
+    kind = dist.get("type", "normal").lower()
+    if kind in ("normal", "gaussian"):
+        mean = float(dist.get("mean", 0.0))
+        std = float(dist.get("std", 1.0))
+        return mean + std * jax.random.normal(key, shape, dtype)
+    if kind == "uniform":
+        lo = float(dist.get("lower", -1.0))
+        hi = float(dist.get("upper", 1.0))
+        return jax.random.uniform(key, shape, dtype, minval=lo, maxval=hi)
+    if kind == "binomial":
+        n = int(dist.get("trials", 1))
+        p = float(dist.get("probability", 0.5))
+        draws = jax.random.bernoulli(key, p, (n,) + tuple(shape))
+        return jnp.sum(draws.astype(dtype), axis=0)
+    if kind == "truncated_normal":
+        mean = float(dist.get("mean", 0.0))
+        std = float(dist.get("std", 1.0))
+        return mean + std * jax.random.truncated_normal(key, -2.0, 2.0, shape, dtype)
+    raise ValueError(f"unknown distribution {kind!r}")

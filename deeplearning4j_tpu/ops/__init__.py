@@ -1,0 +1,9 @@
+"""Tensor-op surface: activations, losses, conv primitives, Pallas kernels.
+
+This package is the analog of the reference's ND4J op surface (the external
+libnd4j engine every layer calls into) re-expressed as jax.numpy / lax /
+Pallas functions that XLA fuses into whole-step programs.
+"""
+
+from deeplearning4j_tpu.ops.activations import Activation, activation_fn, register_activation
+from deeplearning4j_tpu.ops.losses import LossFunction, loss_value, register_loss

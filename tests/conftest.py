@@ -1,0 +1,28 @@
+"""Test harness configuration.
+
+Mirrors the reference's test-backend strategy (SURVEY.md §4): tests run on
+the CPU backend with a virtual 8-device mesh so data-parallel equivalence
+tests (n-device == 1-device) run without TPU hardware — the analog of the
+reference's local[N] Spark contexts and thread-based ParallelWrapper tests.
+
+Must set env vars before jax is imported anywhere.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng_key():
+    import jax
+
+    return jax.random.PRNGKey(12345)

@@ -1,0 +1,86 @@
+"""Activation function tests (reference behavior: org.nd4j activations)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.ops.activations import (
+    Activation,
+    activation_fn,
+    apply_activation,
+    register_activation,
+)
+
+ALL_SIMPLE = [
+    "identity", "sigmoid", "tanh", "relu", "leakyrelu", "elu", "selu",
+    "softplus", "softsign", "hardtanh", "hardsigmoid", "cube",
+    "rationaltanh", "rectifiedtanh", "swish", "gelu", "mish", "softmax",
+    "logsoftmax", "relu6", "thresholdedrelu",
+]
+
+
+@pytest.mark.parametrize("name", ALL_SIMPLE)
+def test_shapes_and_finiteness(name):
+    x = jnp.linspace(-3.0, 3.0, 24).reshape(4, 6)
+    y = apply_activation(name, x)
+    assert y.shape == x.shape
+    assert bool(jnp.all(jnp.isfinite(y)))
+
+
+def test_known_values():
+    x = jnp.array([[-1.0, 0.0, 2.0]])
+    np.testing.assert_allclose(apply_activation("relu", x), [[0.0, 0.0, 2.0]])
+    np.testing.assert_allclose(apply_activation("cube", x), [[-1.0, 0.0, 8.0]])
+    np.testing.assert_allclose(apply_activation("hardtanh", x), [[-1.0, 0.0, 1.0]])
+    np.testing.assert_allclose(
+        apply_activation("hardsigmoid", x), [[0.3, 0.5, 0.9]], atol=1e-6
+    )
+    np.testing.assert_allclose(
+        apply_activation("identity", x), x
+    )
+
+
+def test_softmax_rows_sum_to_one():
+    x = jax.random.normal(jax.random.PRNGKey(0), (5, 7))
+    y = apply_activation("softmax", x)
+    np.testing.assert_allclose(jnp.sum(y, axis=-1), np.ones(5), atol=1e-6)
+
+
+def test_rrelu_train_vs_inference():
+    x = jnp.array([[-2.0, 3.0]])
+    fn = activation_fn("rrelu")
+    # Inference: deterministic slope (l+u)/2 = (1/8 + 1/3)/2
+    y = fn(x, training=False)
+    slope = (1.0 / 8.0 + 1.0 / 3.0) / 2.0
+    np.testing.assert_allclose(y, [[-2.0 * slope, 3.0]], rtol=1e-6)
+    # Training: random slope in [1/8, 1/3], positive side unchanged
+    yt = fn(x, key=jax.random.PRNGKey(1), training=True)
+    assert float(yt[0, 1]) == 3.0
+    assert -2.0 / 3.0 - 1e-6 <= float(yt[0, 0]) <= -2.0 / 8.0 + 1e-6
+
+
+def test_rationaltanh_bounded():
+    x = jnp.linspace(-10, 10, 101)
+    y = apply_activation("rationaltanh", x)
+    assert bool(jnp.all(jnp.abs(y) <= 1.7159 + 1e-5))
+    # odd function
+    np.testing.assert_allclose(y, -y[::-1], atol=1e-5)
+
+
+def test_custom_activation_spi():
+    register_activation("doubler", lambda x, key=None, training=False: 2 * x)
+    np.testing.assert_allclose(
+        apply_activation("doubler", jnp.array([1.0, 2.0])), [2.0, 4.0]
+    )
+
+
+def test_unknown_raises():
+    with pytest.raises(ValueError):
+        activation_fn("nope")
+
+
+def test_enum_names_resolve():
+    for name in vars(Activation):
+        if not name.startswith("_"):
+            activation_fn(getattr(Activation, name))

@@ -1,0 +1,72 @@
+"""Weight initialization tests (reference: WeightInitUtil semantics)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.weights import WeightInit, init_weights
+
+SCHEMES = [
+    WeightInit.ZERO, WeightInit.ONES, WeightInit.UNIFORM, WeightInit.XAVIER,
+    WeightInit.XAVIER_UNIFORM, WeightInit.XAVIER_FAN_IN,
+    WeightInit.XAVIER_LEGACY, WeightInit.RELU, WeightInit.RELU_UNIFORM,
+    WeightInit.SIGMOID_UNIFORM, WeightInit.LECUN_NORMAL,
+    WeightInit.LECUN_UNIFORM, WeightInit.NORMAL,
+]
+
+
+@pytest.mark.parametrize("scheme", SCHEMES)
+def test_shape_and_determinism(scheme, rng_key):
+    w1 = init_weights(rng_key, (64, 32), 64, 32, scheme)
+    w2 = init_weights(rng_key, (64, 32), 64, 32, scheme)
+    assert w1.shape == (64, 32)
+    np.testing.assert_array_equal(w1, w2)  # same key -> same draw
+
+
+def test_xavier_statistics():
+    key = jax.random.PRNGKey(7)
+    fan_in, fan_out = 400, 300
+    w = init_weights(key, (fan_in, fan_out), fan_in, fan_out, WeightInit.XAVIER)
+    expected_std = (2.0 / (fan_in + fan_out)) ** 0.5
+    assert abs(float(jnp.std(w)) - expected_std) / expected_std < 0.05
+    assert abs(float(jnp.mean(w))) < 0.001
+
+
+def test_relu_statistics():
+    key = jax.random.PRNGKey(8)
+    w = init_weights(key, (500, 500), 500, 500, WeightInit.RELU)
+    expected_std = (2.0 / 500) ** 0.5
+    assert abs(float(jnp.std(w)) - expected_std) / expected_std < 0.05
+
+
+def test_uniform_bounds():
+    key = jax.random.PRNGKey(9)
+    w = init_weights(key, (100, 100), 100, 100, WeightInit.UNIFORM)
+    bound = 1.0 / 10.0
+    assert float(jnp.max(jnp.abs(w))) <= bound + 1e-7
+
+
+def test_distribution_init():
+    key = jax.random.PRNGKey(10)
+    w = init_weights(
+        key, (200, 200), 200, 200, WeightInit.DISTRIBUTION,
+        distribution={"type": "normal", "mean": 3.0, "std": 0.5},
+    )
+    assert abs(float(jnp.mean(w)) - 3.0) < 0.05
+    u = init_weights(
+        key, (50, 50), 50, 50, WeightInit.DISTRIBUTION,
+        distribution={"type": "uniform", "lower": 0.0, "upper": 2.0},
+    )
+    assert float(jnp.min(u)) >= 0.0 and float(jnp.max(u)) <= 2.0
+
+
+def test_identity_init():
+    w = init_weights(jax.random.PRNGKey(0), (4, 4), 4, 4, WeightInit.IDENTITY)
+    np.testing.assert_array_equal(w, jnp.eye(4))
+
+
+def test_different_keys_differ():
+    w1 = init_weights(jax.random.PRNGKey(1), (10, 10), 10, 10, WeightInit.XAVIER)
+    w2 = init_weights(jax.random.PRNGKey(2), (10, 10), 10, 10, WeightInit.XAVIER)
+    assert not np.allclose(np.asarray(w1), np.asarray(w2))
