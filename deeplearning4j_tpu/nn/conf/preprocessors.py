@@ -1,0 +1,156 @@
+"""Input pre-processors — shape adapters between layer families.
+
+Analog of the reference's nn/conf/preprocessor/ (12 classes:
+CnnToFeedForwardPreProcessor, FeedForwardToCnnPreProcessor,
+RnnToFeedForwardPreProcessor, ...). Here each is a config dataclass with a
+pure forward function; the backward direction is free via autodiff, where
+the reference hand-writes backprop() per preprocessor.
+
+Layout note: CNN activations are NHWC (TPU-native), so Cnn<->FeedForward is
+a plain reshape with channels fastest-varying — different flattening order
+from the reference's NCHW, by design. Rnn<->FeedForward merges/splits the
+time axis: [batch, time, size] <-> [batch*time, size] (reference:
+RnnToFeedForwardPreProcessor.java).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.inputs import (
+    ConvolutionalInput,
+    FeedForwardInput,
+    RecurrentInput,
+)
+from deeplearning4j_tpu.nn.conf.serde import register_config
+
+
+@dataclasses.dataclass(kw_only=True)
+class InputPreProcessor:
+    def __call__(self, x, state=None):
+        raise NotImplementedError
+
+    def output_type(self, it):
+        raise NotImplementedError
+
+
+@register_config("preproc.cnn_to_ff")
+@dataclasses.dataclass(kw_only=True)
+class CnnToFeedForwardPreProcessor(InputPreProcessor):
+    height: int = 0
+    width: int = 0
+    channels: int = 0
+
+    def __call__(self, x, state=None):
+        return x.reshape(x.shape[0], -1)
+
+    def output_type(self, it):
+        return FeedForwardInput(it.arity())
+
+
+@register_config("preproc.ff_to_cnn")
+@dataclasses.dataclass(kw_only=True)
+class FeedForwardToCnnPreProcessor(InputPreProcessor):
+    height: int = 0
+    width: int = 0
+    channels: int = 1
+
+    def __call__(self, x, state=None):
+        return x.reshape(x.shape[0], self.height, self.width, self.channels)
+
+    def output_type(self, it):
+        return ConvolutionalInput(self.height, self.width, self.channels)
+
+
+@register_config("preproc.rnn_to_ff")
+@dataclasses.dataclass(kw_only=True)
+class RnnToFeedForwardPreProcessor(InputPreProcessor):
+    """[batch, time, size] -> [batch*time, size] so dense layers apply
+    time-distributed (reference: RnnToFeedForwardPreProcessor.java)."""
+
+    def __call__(self, x, state=None):
+        return x.reshape(-1, x.shape[-1])
+
+    def output_type(self, it):
+        return FeedForwardInput(it.size)
+
+
+@register_config("preproc.ff_to_rnn")
+@dataclasses.dataclass(kw_only=True)
+class FeedForwardToRnnPreProcessor(InputPreProcessor):
+    """[batch*time, size] -> [batch, time, size]; time length comes from the
+    network's current minibatch context (passed via state)."""
+
+    def __call__(self, x, state=None):
+        ts = state["timesteps"] if state else -1
+        return x.reshape(-1, ts, x.shape[-1])
+
+    def output_type(self, it):
+        return RecurrentInput(it.arity())
+
+
+@register_config("preproc.cnn_to_rnn")
+@dataclasses.dataclass(kw_only=True)
+class CnnToRnnPreProcessor(InputPreProcessor):
+    """[batch, h, w, c] -> [batch, time=h, size=w*c]
+    (reference: CnnToRnnPreProcessor.java, adapted to NHWC)."""
+
+    def __call__(self, x, state=None):
+        b, h, w, c = x.shape
+        return x.reshape(b, h, w * c)
+
+    def output_type(self, it):
+        return RecurrentInput(it.width * it.channels, it.height)
+
+
+@register_config("preproc.rnn_to_cnn")
+@dataclasses.dataclass(kw_only=True)
+class RnnToCnnPreProcessor(InputPreProcessor):
+    height: int = 0
+    width: int = 0
+    channels: int = 1
+
+    def __call__(self, x, state=None):
+        b = x.shape[0]
+        return x.reshape(b, self.height, self.width, self.channels)
+
+    def output_type(self, it):
+        return ConvolutionalInput(self.height, self.width, self.channels)
+
+
+@register_config("preproc.flat_to_cnn")
+@dataclasses.dataclass(kw_only=True)
+class FlatToCnnPreProcessor(InputPreProcessor):
+    """Flattened image rows -> NHWC image (the reshape behind
+    InputType.convolutional_flat, reference: FeedForwardToCnnPreProcessor
+    inserted by MultiLayerConfiguration for convolutionalFlat input)."""
+
+    height: int = 0
+    width: int = 0
+    channels: int = 1
+
+    def __call__(self, x, state=None):
+        return x.reshape(x.shape[0], self.height, self.width, self.channels)
+
+    def output_type(self, it):
+        return ConvolutionalInput(self.height, self.width, self.channels)
+
+
+@register_config("preproc.composable")
+@dataclasses.dataclass(kw_only=True)
+class ComposableInputPreProcessor(InputPreProcessor):
+    """Chain of preprocessors (reference: ComposableInputPreProcessor.java)."""
+
+    processors: list = dataclasses.field(default_factory=list)
+
+    def __call__(self, x, state=None):
+        for p in self.processors:
+            x = p(x, state)
+        return x
+
+    def output_type(self, it):
+        for p in self.processors:
+            it = p.output_type(it)
+        return it

@@ -1,0 +1,77 @@
+"""Polymorphic JSON serialization for config dataclasses.
+
+Analog of the reference's Jackson-based nn/conf/serde (JSON/YAML round trip
+with layer-type polymorphism). Every config dataclass registers under a
+stable type tag; nested configs serialize recursively. The JSON layout —
+{"type": <tag>, ...fields} — is this framework's cross-version compat
+surface, guarded by regression tests the same way the reference guards
+configuration.json (SURVEY.md §4 "Serialization regression tests").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Dict, Type
+
+_TYPE_REGISTRY: Dict[str, Type] = {}
+_CLASS_TAGS: Dict[Type, str] = {}
+
+
+def register_config(tag: str):
+    """Class decorator: register a dataclass under a stable JSON type tag."""
+
+    def deco(cls):
+        _TYPE_REGISTRY[tag] = cls
+        _CLASS_TAGS[cls] = tag
+        return cls
+
+    return deco
+
+
+def config_to_dict(obj: Any) -> Any:
+    """Recursively serialize a registered config dataclass to plain dicts."""
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        tag = _CLASS_TAGS.get(type(obj))
+        out = {}
+        if tag is not None:
+            out["type"] = tag
+        for f in dataclasses.fields(obj):
+            out[f.name] = config_to_dict(getattr(obj, f.name))
+        return out
+    if isinstance(obj, dict):
+        return {k: config_to_dict(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [config_to_dict(v) for v in obj]
+    return obj
+
+
+def config_from_dict(d: Any) -> Any:
+    """Inverse of config_to_dict. Dicts carrying a registered "type" tag are
+    rebuilt as their dataclass; unknown tags raise (fail loudly, like the
+    reference's legacy-format checks)."""
+    if isinstance(d, dict):
+        tag = d.get("type")
+        if tag is not None and tag in _TYPE_REGISTRY:
+            cls = _TYPE_REGISTRY[tag]
+            field_names = {f.name for f in dataclasses.fields(cls)}
+            kwargs = {
+                k: config_from_dict(v)
+                for k, v in d.items()
+                if k != "type" and k in field_names
+            }
+            return cls(**kwargs)
+        if tag is not None and tag not in _TYPE_REGISTRY:
+            raise ValueError(f"unknown config type tag {tag!r}")
+        return {k: config_from_dict(v) for k, v in d.items()}
+    if isinstance(d, list):
+        return [config_from_dict(v) for v in d]
+    return d
+
+
+def config_to_json(obj: Any, indent: int = 2) -> str:
+    return json.dumps(config_to_dict(obj), indent=indent)
+
+
+def config_from_json(s: str) -> Any:
+    return config_from_dict(json.loads(s))

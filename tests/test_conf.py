@@ -1,0 +1,161 @@
+"""Config DSL + JSON serde tests (reference: nn/conf serde + regression
+tests for configuration.json round trips)."""
+
+import dataclasses
+
+import pytest
+
+from deeplearning4j_tpu.nn.conf import (
+    BatchNormalization,
+    ConvolutionLayer,
+    DenseLayer,
+    GravesLSTM,
+    InputType,
+    MultiLayerConfiguration,
+    NeuralNetConfiguration,
+    OutputLayer,
+    RnnOutputLayer,
+    SubsamplingLayer,
+    Updater,
+)
+from deeplearning4j_tpu.nn.conf.inputs import ConvolutionalInput, FeedForwardInput
+from deeplearning4j_tpu.nn.conf.preprocessors import (
+    CnnToFeedForwardPreProcessor,
+    FlatToCnnPreProcessor,
+    RnnToFeedForwardPreProcessor,
+)
+
+
+def lenet_conf():
+    return (
+        NeuralNetConfiguration.builder()
+        .seed(123)
+        .updater(Updater.NESTEROVS)
+        .learning_rate(0.01)
+        .momentum(0.9)
+        .weight_init("xavier")
+        .list()
+        .layer(ConvolutionLayer(kernel_size=(5, 5), n_out=20, activation="identity"))
+        .layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+        .layer(ConvolutionLayer(kernel_size=(5, 5), n_out=50, activation="identity"))
+        .layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+        .layer(DenseLayer(n_out=500, activation="relu"))
+        .layer(OutputLayer(n_out=10, activation="softmax", loss="mcxent"))
+        .set_input_type(InputType.convolutional_flat(28, 28, 1))
+        .build()
+    )
+
+
+def test_global_defaults_inherited():
+    conf = lenet_conf()
+    # dense layer had explicit activation; weight_init inherited
+    assert conf.layers[4].weight_init == "xavier"
+    assert conf.layers[4].activation == "relu"
+    assert conf.layers[0].activation == "identity"
+    assert conf.net_conf.updater == "nesterovs"
+    assert conf.net_conf.momentum == 0.9
+
+
+def test_shape_inference_lenet():
+    conf = lenet_conf()
+    # conv1: 28 -> 24, pool -> 12, conv2 -> 8, pool -> 4
+    assert conf.layers[0].n_in == 1
+    assert conf.layers[2].n_in == 20
+    assert conf.layers[4].n_in == 4 * 4 * 50
+    assert conf.layers[5].n_in == 500
+    # automatic preprocessors: flat->cnn at 0, cnn->ff at 4
+    assert isinstance(conf.preprocessors["0"], FlatToCnnPreProcessor)
+    assert isinstance(conf.preprocessors["4"], CnnToFeedForwardPreProcessor)
+
+
+def test_input_types_per_layer():
+    conf = lenet_conf()
+    its = conf.input_types_per_layer()
+    assert isinstance(its[0], ConvolutionalInput)
+    assert (its[0].height, its[0].width, its[0].channels) == (28, 28, 1)
+    assert isinstance(its[4], FeedForwardInput)
+    assert its[4].size == 800
+
+
+def test_json_round_trip():
+    conf = lenet_conf()
+    s = conf.to_json()
+    conf2 = MultiLayerConfiguration.from_json(s)
+    assert conf2.to_json() == s
+    assert len(conf2.layers) == 6
+    assert isinstance(conf2.layers[0], ConvolutionLayer)
+    assert conf2.layers[0].n_out == 20
+    assert list(conf2.layers[0].kernel_size) == [5, 5]
+    assert conf2.net_conf.learning_rate == 0.01
+    assert isinstance(conf2.preprocessors["0"], FlatToCnnPreProcessor)
+
+
+def test_rnn_conf_inference():
+    conf = (
+        NeuralNetConfiguration.builder()
+        .list()
+        .layer(GravesLSTM(n_out=64, activation="tanh"))
+        .layer(RnnOutputLayer(n_out=10, activation="softmax", loss="mcxent"))
+        .set_input_type(InputType.recurrent(32))
+        .build()
+    )
+    assert conf.layers[0].n_in == 32
+    assert conf.layers[1].n_in == 64
+
+
+def test_rnn_to_dense_preprocessor():
+    conf = (
+        NeuralNetConfiguration.builder()
+        .list()
+        .layer(GravesLSTM(n_out=8))
+        .layer(DenseLayer(n_out=4))
+        .set_input_type(InputType.recurrent(5))
+        .build()
+    )
+    assert isinstance(conf.preprocessors["1"], RnnToFeedForwardPreProcessor)
+
+
+def test_manual_n_in_wiring_without_input_type():
+    conf = (
+        NeuralNetConfiguration.builder()
+        .list()
+        .layer(DenseLayer(n_in=10, n_out=20))
+        .layer(DenseLayer(n_out=5))
+        .layer(OutputLayer(n_out=3))
+        .build()
+    )
+    assert conf.layers[1].n_in == 20
+    assert conf.layers[2].n_in == 5
+
+
+def test_batchnorm_n_in_from_cnn():
+    conf = (
+        NeuralNetConfiguration.builder()
+        .list()
+        .layer(ConvolutionLayer(kernel_size=(3, 3), n_out=8))
+        .layer(BatchNormalization())
+        .layer(OutputLayer(n_out=2, activation="softmax"))
+        .set_input_type(InputType.convolutional(10, 10, 3))
+        .build()
+    )
+    assert conf.layers[1].n_in == 8
+
+
+def test_same_mode_shapes():
+    conf = (
+        NeuralNetConfiguration.builder()
+        .list()
+        .layer(ConvolutionLayer(kernel_size=(3, 3), stride=(2, 2), n_out=4,
+                                convolution_mode="same"))
+        .layer(OutputLayer(n_out=2, activation="softmax"))
+        .set_input_type(InputType.convolutional(9, 9, 1))
+        .build()
+    )
+    its = conf.input_types_per_layer()
+    # ceil(9/2) = 5
+    assert (its[1].size) == 5 * 5 * 4
+
+
+def test_unknown_type_tag_raises():
+    with pytest.raises(ValueError):
+        MultiLayerConfiguration.from_json('{"type": "layer.bogus_thing"}')
