@@ -1,0 +1,212 @@
+"""Convolution, pooling, padding and global pooling layers (NHWC).
+
+Reference impls: nn/layers/convolution/ConvolutionLayer.java:177-201
+(im2col -> reshape -> Nd4j.gemm) and the cuDNN helper plugin
+(deeplearning4j-cuda CudnnConvolutionHelper.java:345). Here the conv lowers
+to lax.conv_general_dilated which XLA tiles straight onto the MXU — no
+explicit im2col buffer and no helper SPI needed for the base path; Pallas
+kernels can still override via ops/ when profiling says so.
+
+Pooling: SubsamplingLayer (max/avg/sum/pnorm) -> lax.reduce_window
+(reference: nn/layers/convolution/subsampling/SubsamplingLayer.java,
+CudnnSubsamplingHelper). Gradients come from autodiff, which XLA rewrites
+to the select-and-scatter form itself.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.nn.conf.layers import ConvolutionMode, PoolingType
+from deeplearning4j_tpu.nn.layers.core import apply_dropout
+from deeplearning4j_tpu.nn.layers.registry import LayerContext, register_layer
+from deeplearning4j_tpu.nn.weights import init_weights
+from deeplearning4j_tpu.ops.activations import apply_activation
+
+_DIMS2D = ("NHWC", "HWIO", "NHWC")
+
+
+def _padding_2d(conf) -> object:
+    if conf.convolution_mode == ConvolutionMode.SAME:
+        return "SAME"
+    p = conf.padding
+    return [(int(p[0]), int(p[0])), (int(p[1]), int(p[1]))]
+
+
+# -- 2D convolution ----------------------------------------------------------
+
+def conv_init(key, conf: L.ConvolutionLayer, dtype):
+    kh, kw_ = int(conf.kernel_size[0]), int(conf.kernel_size[1])
+    fan_in = conf.n_in * kh * kw_
+    fan_out = conf.n_out * kh * kw_
+    k1, _ = jax.random.split(key)
+    W = init_weights(k1, (kh, kw_, conf.n_in, conf.n_out), fan_in, fan_out,
+                     conf.weight_init, conf.dist, dtype)
+    out = {"W": W}
+    if conf.has_bias:
+        out["b"] = jnp.full((conf.n_out,), conf.bias_init or 0.0, dtype)
+    return out
+
+
+def conv_forward(conf: L.ConvolutionLayer, params, x, ctx: LayerContext):
+    x = apply_dropout(x, conf.dropout, ctx)
+    z = lax.conv_general_dilated(
+        x,
+        params["W"].astype(x.dtype),
+        window_strides=tuple(int(s) for s in conf.stride),
+        padding=_padding_2d(conf),
+        rhs_dilation=tuple(int(d) for d in conf.dilation),
+        dimension_numbers=_DIMS2D,
+    )
+    if conf.has_bias:
+        z = z + params["b"].astype(z.dtype)
+    return apply_activation(conf.activation, z, key=ctx.rng, training=ctx.training), None
+
+
+def conv_order(conf):
+    return ("W", "b") if conf.has_bias else ("W",)
+
+
+register_layer(L.ConvolutionLayer, conv_init, conv_forward, order_fn=conv_order)
+
+
+# -- 1D convolution over time ------------------------------------------------
+
+def conv1d_init(key, conf: L.Convolution1DLayer, dtype):
+    k = int(conf.kernel_size)
+    fan_in = conf.n_in * k
+    fan_out = conf.n_out * k
+    k1, _ = jax.random.split(key)
+    W = init_weights(k1, (k, conf.n_in, conf.n_out), fan_in, fan_out,
+                     conf.weight_init, conf.dist, dtype)
+    out = {"W": W}
+    if conf.has_bias:
+        out["b"] = jnp.full((conf.n_out,), conf.bias_init or 0.0, dtype)
+    return out
+
+
+def conv1d_forward(conf: L.Convolution1DLayer, params, x, ctx: LayerContext):
+    # x: [batch, time, nIn]
+    x = apply_dropout(x, conf.dropout, ctx)
+    if conf.convolution_mode == ConvolutionMode.SAME:
+        padding = "SAME"
+    else:
+        padding = [(int(conf.padding), int(conf.padding))]
+    z = lax.conv_general_dilated(
+        x, params["W"].astype(x.dtype),
+        window_strides=(int(conf.stride),),
+        padding=padding,
+        dimension_numbers=("NWC", "WIO", "NWC"),
+    )
+    if conf.has_bias:
+        z = z + params["b"].astype(z.dtype)
+    return apply_activation(conf.activation, z, key=ctx.rng, training=ctx.training), None
+
+
+register_layer(L.Convolution1DLayer, conv1d_init, conv1d_forward, order_fn=conv_order)
+
+
+# -- pooling -----------------------------------------------------------------
+
+def _pool(x, pooling_type, window, strides, padding, pnorm):
+    """reduce_window pooling over explicitly-windowed axes. window/strides
+    are full-rank tuples (1s for batch/channel)."""
+    if pooling_type == PoolingType.MAX:
+        neg_inf = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        return lax.reduce_window(x, neg_inf, lax.max, window, strides, padding)
+    if pooling_type == PoolingType.SUM:
+        return lax.reduce_window(x, 0.0, lax.add, window, strides, padding)
+    if pooling_type == PoolingType.AVG:
+        s = lax.reduce_window(x, 0.0, lax.add, window, strides, padding)
+        n = 1
+        for w in window:
+            n *= w
+        return s / n
+    if pooling_type == PoolingType.PNORM:
+        p = float(pnorm)
+        s = lax.reduce_window(jnp.abs(x) ** p, 0.0, lax.add, window, strides, padding)
+        return s ** (1.0 / p)
+    raise ValueError(f"unknown pooling type {pooling_type!r}")
+
+
+def _no_params(key, conf, dtype):
+    return {}
+
+
+def subsampling_forward(conf: L.SubsamplingLayer, params, x, ctx: LayerContext):
+    window = (1, int(conf.kernel_size[0]), int(conf.kernel_size[1]), 1)
+    strides = (1, int(conf.stride[0]), int(conf.stride[1]), 1)
+    if conf.convolution_mode == ConvolutionMode.SAME:
+        padding = "SAME"
+    else:
+        p = conf.padding
+        padding = [(0, 0), (int(p[0]), int(p[0])), (int(p[1]), int(p[1])), (0, 0)]
+    return _pool(x, conf.pooling_type, window, strides, padding, conf.pnorm), None
+
+
+register_layer(L.SubsamplingLayer, _no_params, subsampling_forward)
+
+
+def subsampling1d_forward(conf: L.Subsampling1DLayer, params, x, ctx: LayerContext):
+    window = (1, int(conf.kernel_size), 1)
+    strides = (1, int(conf.stride), 1)
+    if conf.convolution_mode == ConvolutionMode.SAME:
+        padding = "SAME"
+    else:
+        padding = [(0, 0), (int(conf.padding), int(conf.padding)), (0, 0)]
+    return _pool(x, conf.pooling_type, window, strides, padding, conf.pnorm), None
+
+
+register_layer(L.Subsampling1DLayer, _no_params, subsampling1d_forward)
+
+
+# -- zero padding ------------------------------------------------------------
+
+def zero_padding_forward(conf: L.ZeroPaddingLayer, params, x, ctx: LayerContext):
+    pt, pb, pl, pr = (int(v) for v in conf.padding)
+    return jnp.pad(x, ((0, 0), (pt, pb), (pl, pr), (0, 0))), None
+
+
+register_layer(L.ZeroPaddingLayer, _no_params, zero_padding_forward)
+
+
+# -- global pooling ----------------------------------------------------------
+
+def global_pooling_forward(conf: L.GlobalPoolingLayer, params, x, ctx: LayerContext):
+    """CNN input [b,h,w,c]: pool h,w. RNN input [b,t,f]: pool t, honoring the
+    time mask (reference: GlobalPoolingLayer.java + MaskedReductionUtil)."""
+    pt = conf.pooling_type
+    if x.ndim == 4:
+        axes = (1, 2)
+        mask = None
+    elif x.ndim == 3:
+        axes = (1,)
+        mask = ctx.mask  # [batch, time]
+    else:
+        raise ValueError(f"global pooling expects 3d/4d input, got shape {x.shape}")
+
+    if mask is not None:
+        m = mask[..., None].astype(x.dtype)
+        if pt == PoolingType.MAX:
+            x = jnp.where(m > 0, x, -jnp.inf)
+        else:
+            x = x * m
+    if pt == PoolingType.MAX:
+        return jnp.max(x, axis=axes), None
+    if pt == PoolingType.SUM:
+        return jnp.sum(x, axis=axes), None
+    if pt == PoolingType.AVG:
+        if mask is not None:
+            denom = jnp.maximum(jnp.sum(mask, axis=1, keepdims=False), 1.0)[..., None]
+            return jnp.sum(x, axis=axes) / denom, None
+        return jnp.mean(x, axis=axes), None
+    if pt == PoolingType.PNORM:
+        p = float(conf.pnorm)
+        return jnp.sum(jnp.abs(x) ** p, axis=axes) ** (1.0 / p), None
+    raise ValueError(f"unknown pooling type {pt!r}")
+
+
+register_layer(L.GlobalPoolingLayer, _no_params, global_pooling_forward)

@@ -1,0 +1,97 @@
+"""Normalization layers: batch normalization and local response normalization.
+
+Reference impls: nn/layers/normalization/BatchNormalization.java (+
+CudnnBatchNormalizationHelper) and LocalResponseNormalization.java (+ cuDNN
+helper). Both compile to fused XLA element-wise/reduction code here; no
+helper SPI required for the base path.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.nn.layers.registry import LayerContext, register_layer
+from deeplearning4j_tpu.ops.activations import apply_activation
+
+
+# -- batch normalization -----------------------------------------------------
+
+def batchnorm_init(key, conf: L.BatchNormalization, dtype):
+    n = int(conf.n_in)
+    return {
+        "gamma": jnp.full((n,), conf.gamma, dtype),
+        "beta": jnp.full((n,), conf.beta, dtype),
+    }
+
+
+def batchnorm_state(conf: L.BatchNormalization, dtype):
+    n = int(conf.n_in)
+    return {"mean": jnp.zeros((n,), dtype), "var": jnp.ones((n,), dtype)}
+
+
+def batchnorm_forward(conf: L.BatchNormalization, params, x, ctx: LayerContext):
+    """Normalizes over all axes but the last (channels for NHWC, features
+    for 2d). Training uses batch statistics and EMA-updates the running
+    stats (decay semantics as the reference: global = decay*global +
+    (1-decay)*batch); inference uses the running stats."""
+    axes = tuple(range(x.ndim - 1))
+    eps = conf.eps
+    state = ctx.state or {}
+    if ctx.training:
+        mean = jnp.mean(x, axis=axes)
+        var = jnp.var(x, axis=axes)
+        d = conf.decay
+        new_state = {
+            "mean": d * state.get("mean", jnp.zeros_like(mean)) + (1 - d) * mean,
+            "var": d * state.get("var", jnp.ones_like(var)) + (1 - d) * var,
+        }
+    else:
+        mean = state.get("mean")
+        var = state.get("var")
+        if mean is None:
+            mean = jnp.mean(x, axis=axes)
+            var = jnp.var(x, axis=axes)
+        new_state = None
+    inv = lax.rsqrt(var.astype(x.dtype) + eps)
+    xhat = (x - mean.astype(x.dtype)) * inv
+    if conf.lock_gamma_beta:
+        y = xhat
+    else:
+        y = params["gamma"].astype(x.dtype) * xhat + params["beta"].astype(x.dtype)
+    return y, new_state
+
+
+def batchnorm_order(conf):
+    return ("gamma", "beta")
+
+
+register_layer(
+    L.BatchNormalization, batchnorm_init, batchnorm_forward,
+    order_fn=batchnorm_order, state_fn=batchnorm_state,
+)
+
+
+# -- local response normalization -------------------------------------------
+
+def _no_params(key, conf, dtype):
+    return {}
+
+
+def lrn_forward(conf: L.LocalResponseNormalization, params, x, ctx: LayerContext):
+    """Cross-channel LRN on NHWC: y = x / (k + alpha*sum_window(x^2))^beta
+    (reference: LocalResponseNormalization.java; window of size n centered
+    on each channel). reduce_window over the channel axis."""
+    n = int(conf.n)
+    half = n // 2
+    sq = x * x
+    window = (1, 1, 1, n)
+    strides = (1, 1, 1, 1)
+    padding = [(0, 0), (0, 0), (0, 0), (half, n - 1 - half)]
+    ssum = lax.reduce_window(sq, 0.0, lax.add, window, strides, padding)
+    denom = (conf.k + conf.alpha * ssum) ** conf.beta
+    return x / denom, None
+
+
+register_layer(L.LocalResponseNormalization, _no_params, lrn_forward)

@@ -1,0 +1,184 @@
+"""Recurrent layers: LSTM, GravesLSTM (peepholes), GravesBidirectionalLSTM.
+
+Reference impl: nn/layers/recurrent/LSTMHelpers.java (574 LoC — fwd
+activateHelper :62, bwd backpropGradientHelper :291, a hand-written
+per-timestep Java loop). TPU-first redesign:
+
+- the input projection for ALL timesteps and ALL four gates is ONE batched
+  matmul ([b,t,nIn] x [nIn,4H]) that saturates the MXU;
+- the sequential part is a lax.scan whose body holds only the [H,4H]
+  recurrent matmul + element-wise gate math, so XLA compiles a single
+  fused loop body instead of per-op dispatch per timestep;
+- the backward pass is autodiff through the scan (no hand-written BPTT).
+
+Gate block layout in the fused [*, 4H] matrices: [i | f | g | o]
+(input gate, forget gate, cell candidate, output gate).
+
+Masking (variable-length sequences): at masked steps the carried (h, c)
+pass through unchanged and the emitted output is zero, which reproduces the
+reference's masked-timestep semantics (TestVariableLengthTS).
+
+Stateful inference / TBPTT: pass ctx.state = {"h": ..., "c": ...} to seed
+the scan; the final state is returned so callers implement rnnTimeStep and
+truncated-BPTT segment carry (reference: MultiLayerNetwork.rnnTimeStep,
+updateRnnStateWithTBPTTState :1321).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.nn.layers.core import apply_dropout
+from deeplearning4j_tpu.nn.layers.registry import LayerContext, register_layer
+from deeplearning4j_tpu.nn.weights import init_weights
+from deeplearning4j_tpu.ops.activations import apply_activation
+
+
+def _lstm_param_set(key, n_in, n_out, conf, dtype, prefix=""):
+    k1, k2 = jax.random.split(key)
+    W = init_weights(k1, (n_in, 4 * n_out), n_in, n_out, conf.weight_init, conf.dist, dtype)
+    RW = init_weights(k2, (n_out, 4 * n_out), n_out, n_out, conf.weight_init, conf.dist, dtype)
+    b = jnp.zeros((4 * n_out,), dtype)
+    # forget-gate bias init (reference: LSTMParamInitializer sets the forget
+    # block of the bias to forgetGateBiasInit, default 1.0)
+    b = b.at[n_out : 2 * n_out].set(conf.forget_gate_bias_init)
+    return {prefix + "W": W, prefix + "RW": RW, prefix + "b": b}
+
+
+def _peephole_params(key, n_out, dtype, prefix=""):
+    ks = jax.random.split(key, 3)
+    scale = 1.0 / jnp.sqrt(jnp.asarray(float(n_out)))
+    return {
+        prefix + "pI": scale * jax.random.normal(ks[0], (n_out,), dtype),
+        prefix + "pF": scale * jax.random.normal(ks[1], (n_out,), dtype),
+        prefix + "pO": scale * jax.random.normal(ks[2], (n_out,), dtype),
+    }
+
+
+def _scan_lstm(conf, params, x, ctx, peephole: bool, prefix: str = "", reverse: bool = False):
+    """Core scan. x: [batch, time, nIn] -> y [batch, time, H], final (h, c)."""
+    H = int(conf.n_out)
+    W = params[prefix + "W"]
+    RW = params[prefix + "RW"]
+    b = params[prefix + "b"]
+    gate_act = conf.gate_activation
+    cell_act = conf.activation
+
+    bsz = x.shape[0]
+    xg = jnp.einsum("bti,ih->bth", x, W.astype(x.dtype)) + b.astype(x.dtype)  # all-timestep MXU matmul
+    xg_t = jnp.swapaxes(xg, 0, 1)  # time-major for scan
+
+    mask = ctx.mask
+    if mask is not None:
+        mask_t = jnp.swapaxes(mask.astype(x.dtype), 0, 1)[..., None]  # [t,b,1]
+    else:
+        mask_t = None
+
+    state = ctx.state or {}
+    h0 = state.get("h")
+    c0 = state.get("c")
+    if h0 is None:
+        h0 = jnp.zeros((bsz, H), x.dtype)
+    if c0 is None:
+        c0 = jnp.zeros((bsz, H), x.dtype)
+
+    if peephole:
+        pI = params[prefix + "pI"].astype(x.dtype)
+        pF = params[prefix + "pF"].astype(x.dtype)
+        pO = params[prefix + "pO"].astype(x.dtype)
+
+    def step(carry, inp):
+        h, c = carry
+        if mask_t is not None:
+            g_in, m = inp
+        else:
+            g_in, m = inp, None
+        g = g_in + h @ RW.astype(h.dtype)  # [b, 4H]
+        gi, gf, gg, go = g[:, :H], g[:, H : 2 * H], g[:, 2 * H : 3 * H], g[:, 3 * H :]
+        if peephole:
+            gi = gi + c * pI
+            gf = gf + c * pF
+        i = apply_activation(gate_act, gi)
+        f = apply_activation(gate_act, gf)
+        gg = apply_activation(cell_act, gg)
+        c_new = f * c + i * gg
+        if peephole:
+            go = go + c_new * pO
+        o = apply_activation(gate_act, go)
+        h_new = o * apply_activation(cell_act, c_new)
+        if m is not None:
+            h_new = m * h_new + (1 - m) * h
+            c_new = m * c_new + (1 - m) * c
+            y = h_new * m
+        else:
+            y = h_new
+        return (h_new, c_new), y
+
+    xs = (xg_t, mask_t) if mask_t is not None else xg_t
+    (hF, cF), ys = lax.scan(step, (h0, c0), xs, reverse=reverse)
+    y = jnp.swapaxes(ys, 0, 1)  # back to [b, t, H]
+    return y, (hF, cF)
+
+
+def _make_lstm_forward(peephole: bool):
+    def fwd(conf, params, x, ctx: LayerContext):
+        x = apply_dropout(x, conf.dropout, ctx)
+        y, (h, c) = _scan_lstm(conf, params, x, ctx, peephole)
+        new_state = {"h": h, "c": c} if ctx.state is not None else None
+        return y, new_state
+
+    return fwd
+
+
+def lstm_init(key, conf: L.LSTM, dtype):
+    return _lstm_param_set(key, int(conf.n_in), int(conf.n_out), conf, dtype)
+
+
+register_layer(L.LSTM, lstm_init, _make_lstm_forward(peephole=False),
+               order_fn=lambda c: ("W", "RW", "b"))
+
+
+def graves_lstm_init(key, conf: L.GravesLSTM, dtype):
+    k1, k2 = jax.random.split(key)
+    p = _lstm_param_set(k1, int(conf.n_in), int(conf.n_out), conf, dtype)
+    p.update(_peephole_params(k2, int(conf.n_out), dtype))
+    return p
+
+
+register_layer(L.GravesLSTM, graves_lstm_init, _make_lstm_forward(peephole=True),
+               order_fn=lambda c: ("W", "RW", "b", "pI", "pF", "pO"))
+
+
+# -- bidirectional -----------------------------------------------------------
+
+def graves_bidirectional_init(key, conf: L.GravesBidirectionalLSTM, dtype):
+    kf, kb = jax.random.split(key)
+    k1, k2 = jax.random.split(kf)
+    k3, k4 = jax.random.split(kb)
+    p = _lstm_param_set(k1, int(conf.n_in), int(conf.n_out), conf, dtype, prefix="f_")
+    p.update(_peephole_params(k2, int(conf.n_out), dtype, prefix="f_"))
+    p.update(_lstm_param_set(k3, int(conf.n_in), int(conf.n_out), conf, dtype, prefix="b_"))
+    p.update(_peephole_params(k4, int(conf.n_out), dtype, prefix="b_"))
+    return p
+
+
+def graves_bidirectional_forward(conf, params, x, ctx: LayerContext):
+    x = apply_dropout(x, conf.dropout, ctx)
+    # Bidirectional layers are never stateful (no streaming inference over a
+    # future-dependent pass) — same restriction as the reference.
+    fwd_ctx = LayerContext(training=ctx.training, rng=ctx.rng, mask=ctx.mask,
+                           timesteps=ctx.timesteps, state=None)
+    yf, _ = _scan_lstm(conf, params, x, fwd_ctx, peephole=True, prefix="f_")
+    yb, _ = _scan_lstm(conf, params, x, fwd_ctx, peephole=True, prefix="b_", reverse=True)
+    # element-wise ADD of directions (GravesBidirectionalLSTM.java:205)
+    return yf + yb, None
+
+
+register_layer(
+    L.GravesBidirectionalLSTM, graves_bidirectional_init, graves_bidirectional_forward,
+    order_fn=lambda c: ("f_W", "f_RW", "f_b", "f_pI", "f_pF", "f_pO",
+                        "b_W", "b_RW", "b_b", "b_pI", "b_pF", "b_pO"),
+)

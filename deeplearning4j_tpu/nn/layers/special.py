@@ -1,0 +1,166 @@
+"""Special layers: variational autoencoder, frozen-layer wrapper.
+
+Reference impls: nn/layers/variational/VariationalAutoencoder.java (1,120
+LoC — internal encoder/decoder MLP, ELBO objective, pluggable
+reconstruction distributions) and nn/layers/FrozenLayer.java.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.nn.layers.registry import (
+    LayerContext,
+    forward_layer,
+    init_layer_params,
+    init_layer_state,
+    param_order,
+    register_layer,
+)
+from deeplearning4j_tpu.nn.weights import init_weights
+from deeplearning4j_tpu.ops.activations import apply_activation
+
+
+# -- variational autoencoder -------------------------------------------------
+
+def _mlp_params(key, sizes, conf, dtype, prefix):
+    params = {}
+    for i in range(len(sizes) - 1):
+        key, k = jax.random.split(key)
+        n_in, n_out = int(sizes[i]), int(sizes[i + 1])
+        params[f"{prefix}{i}_W"] = init_weights(
+            k, (n_in, n_out), n_in, n_out, conf.weight_init, conf.dist, dtype
+        )
+        params[f"{prefix}{i}_b"] = jnp.zeros((n_out,), dtype)
+    return params
+
+
+def vae_init(key, conf: L.VariationalAutoencoder, dtype):
+    n_in, n_z = int(conf.n_in), int(conf.n_out)
+    enc = [n_in] + list(conf.encoder_layer_sizes)
+    dec = [n_z] + list(conf.decoder_layer_sizes)
+    k1, k2, k3, k4, k5, k6 = jax.random.split(key, 6)
+    params = {}
+    params.update(_mlp_params(k1, enc, conf, dtype, "enc_"))
+    last_e = int(enc[-1])
+    params["pzx_mean_W"] = init_weights(k2, (last_e, n_z), last_e, n_z,
+                                        conf.weight_init, conf.dist, dtype)
+    params["pzx_mean_b"] = jnp.zeros((n_z,), dtype)
+    params["pzx_logstd_W"] = init_weights(k3, (last_e, n_z), last_e, n_z,
+                                          conf.weight_init, conf.dist, dtype)
+    params["pzx_logstd_b"] = jnp.zeros((n_z,), dtype)
+    params.update(_mlp_params(k4, dec, conf, dtype, "dec_"))
+    last_d = int(dec[-1])
+    # reconstruction distribution parameters: gaussian needs mean+logstd
+    # (2*n_in outputs), bernoulli needs n_in probabilities
+    dist = (conf.reconstruction_distribution or {"type": "bernoulli"})
+    out_mult = 2 if dist.get("type", "bernoulli") == "gaussian" else 1
+    params["pxz_W"] = init_weights(k5, (last_d, out_mult * n_in), last_d, n_in,
+                                   conf.weight_init, conf.dist, dtype)
+    params["pxz_b"] = jnp.zeros((out_mult * n_in,), dtype)
+    return params
+
+
+def _vae_encode(conf, params, x):
+    h = x
+    for i in range(len(conf.encoder_layer_sizes)):
+        h = apply_activation(conf.activation, h @ params[f"enc_{i}_W"] + params[f"enc_{i}_b"])
+    mean = apply_activation(conf.pzx_activation,
+                            h @ params["pzx_mean_W"] + params["pzx_mean_b"])
+    log_std = h @ params["pzx_logstd_W"] + params["pzx_logstd_b"]
+    return mean, log_std
+
+
+def _vae_decode(conf, params, z):
+    h = z
+    for i in range(len(conf.decoder_layer_sizes)):
+        h = apply_activation(conf.activation, h @ params[f"dec_{i}_W"] + params[f"dec_{i}_b"])
+    return h @ params["pxz_W"] + params["pxz_b"]
+
+
+def vae_forward(conf: L.VariationalAutoencoder, params, x, ctx: LayerContext):
+    """Supervised path: the layer's activation is the mean of p(z|x)
+    (reference: VariationalAutoencoder.activate returns the pzxMean)."""
+    mean, _ = _vae_encode(conf, params, x)
+    return mean, None
+
+
+def vae_elbo(conf: L.VariationalAutoencoder, params, x, rng, training=True):
+    """Negative ELBO per example (the unsupervised pretraining objective;
+    reference: VariationalAutoencoder.computeGradientAndScore). Monte-Carlo
+    with conf.num_samples samples via the reparameterization trick."""
+    mean, log_std = _vae_encode(conf, params, x)
+    # KL(q(z|x) || N(0,I)), analytic
+    var = jnp.exp(2.0 * log_std)
+    kl = 0.5 * jnp.sum(mean * mean + var - 2.0 * log_std - 1.0, axis=-1)
+    dist = (conf.reconstruction_distribution or {"type": "bernoulli"})
+    kind = dist.get("type", "bernoulli")
+    n_in = int(conf.n_in)
+
+    recon = 0.0
+    n_samples = int(conf.num_samples) if training else 1
+    for s in range(n_samples):
+        rng, k = jax.random.split(rng)
+        eps = jax.random.normal(k, mean.shape, mean.dtype)
+        z = mean + jnp.exp(log_std) * eps
+        out = _vae_decode(conf, params, z)
+        if kind == "gaussian":
+            act = dist.get("activation", "identity")
+            r_mean = apply_activation(act, out[:, :n_in])
+            r_logstd = out[:, n_in:]
+            # -log N(x; r_mean, exp(r_logstd)^2)
+            nll = 0.5 * jnp.sum(
+                ((x - r_mean) ** 2) * jnp.exp(-2.0 * r_logstd)
+                + 2.0 * r_logstd + math.log(2.0 * math.pi),
+                axis=-1,
+            )
+        else:  # bernoulli
+            # stable from logits
+            nll = jnp.sum(
+                x * jax.nn.softplus(-out) + (1.0 - x) * jax.nn.softplus(out), axis=-1
+            )
+        recon = recon + nll
+    recon = recon / n_samples
+    return recon + kl
+
+
+def vae_order(conf: L.VariationalAutoencoder):
+    names = []
+    for i in range(len(conf.encoder_layer_sizes)):
+        names += [f"enc_{i}_W", f"enc_{i}_b"]
+    names += ["pzx_mean_W", "pzx_mean_b", "pzx_logstd_W", "pzx_logstd_b"]
+    for i in range(len(conf.decoder_layer_sizes)):
+        names += [f"dec_{i}_W", f"dec_{i}_b"]
+    names += ["pxz_W", "pxz_b"]
+    return tuple(names)
+
+
+register_layer(L.VariationalAutoencoder, vae_init, vae_forward, order_fn=vae_order)
+
+
+# -- frozen wrapper ----------------------------------------------------------
+
+def frozen_init(key, conf: L.FrozenLayer, dtype):
+    return init_layer_params(key, conf.inner, dtype)
+
+
+def frozen_state(conf: L.FrozenLayer, dtype):
+    return init_layer_state(conf.inner, dtype)
+
+
+def frozen_forward(conf: L.FrozenLayer, params, x, ctx: LayerContext):
+    """Delegates to the inner layer in inference mode (no dropout; frozen
+    BN uses running stats) — reference: FrozenLayer applies the layer as in
+    test time. Gradient zeroing happens in the updater via trainable masks."""
+    inner_ctx = LayerContext(training=False, rng=ctx.rng, mask=ctx.mask,
+                             timesteps=ctx.timesteps, state=ctx.state)
+    y, _ = forward_layer(conf.inner, params, x, inner_ctx)
+    return y, None
+
+
+register_layer(L.FrozenLayer, frozen_init, frozen_forward,
+               order_fn=lambda c: param_order(c.inner), state_fn=frozen_state)

@@ -11,12 +11,22 @@ Must set env vars before jax is imported anywhere.
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Note: this image's axon sitecustomize imports jax at interpreter start, so
+# env vars set here are read too late; the config updates below are what
+# actually select the CPU backend (backends initialize lazily). XLA_FLAGS is
+# still read at first backend init, so setting it here works.
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+# Numeric parity tests assume true-f32 matmuls/convs (the TPU bench path
+# deliberately runs bf16 — that is a PrecisionPolicy choice, not a default).
+jax.config.update("jax_default_matmul_precision", "highest")
 
 import pytest  # noqa: E402
 

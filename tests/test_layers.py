@@ -1,0 +1,253 @@
+"""Layer forward-pass tests (reference: nn/layers/* behavior)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.nn.layers import forward_layer, init_layer_params, init_layer_state
+from deeplearning4j_tpu.nn.layers.registry import LayerContext
+
+F32 = jnp.float32
+
+
+def _mk(conf, **defaults):
+    # fill network-default fields a builder would normally set
+    for k, v in dict(activation="tanh", weight_init="xavier", bias_init=0.0,
+                     l1=0.0, l2=0.0, dropout=0.0, **defaults).items():
+        if hasattr(conf, k) and getattr(conf, k) is None:
+            setattr(conf, k, v)
+    return conf
+
+
+def test_dense_forward_shape_and_math():
+    conf = _mk(L.DenseLayer(n_in=4, n_out=3, activation="identity"))
+    p = init_layer_params(jax.random.PRNGKey(0), conf, F32)
+    x = jnp.ones((2, 4))
+    y, _ = forward_layer(conf, p, x, LayerContext())
+    assert y.shape == (2, 3)
+    np.testing.assert_allclose(y, x @ p["W"] + p["b"], rtol=1e-6)
+
+
+def test_dropout_train_vs_test():
+    conf = _mk(L.DenseLayer(n_in=10, n_out=10, activation="identity", dropout=0.5))
+    p = init_layer_params(jax.random.PRNGKey(0), conf, F32)
+    x = jnp.ones((4, 10))
+    y_test, _ = forward_layer(conf, p, x, LayerContext(training=False))
+    np.testing.assert_allclose(y_test, x @ p["W"] + p["b"], rtol=1e-6)
+    y_tr, _ = forward_layer(conf, p, x, LayerContext(training=True, rng=jax.random.PRNGKey(1)))
+    assert not np.allclose(np.asarray(y_tr), np.asarray(y_test))
+
+
+def test_conv_shapes_truncate_and_same():
+    conf = _mk(L.ConvolutionLayer(n_in=3, n_out=8, kernel_size=(3, 3),
+                                  stride=(1, 1), activation="relu"))
+    p = init_layer_params(jax.random.PRNGKey(0), conf, F32)
+    x = jnp.ones((2, 10, 10, 3))
+    y, _ = forward_layer(conf, p, x, LayerContext())
+    assert y.shape == (2, 8, 8, 8)
+
+    conf2 = _mk(L.ConvolutionLayer(n_in=3, n_out=8, kernel_size=(3, 3),
+                                   stride=(2, 2), convolution_mode="same",
+                                   activation="relu"))
+    p2 = init_layer_params(jax.random.PRNGKey(0), conf2, F32)
+    y2, _ = forward_layer(conf2, p2, x, LayerContext())
+    assert y2.shape == (2, 5, 5, 8)
+
+
+def test_conv_identity_kernel():
+    # 1x1 conv with identity weights reproduces input channels
+    conf = _mk(L.ConvolutionLayer(n_in=2, n_out=2, kernel_size=(1, 1),
+                                  activation="identity"))
+    p = init_layer_params(jax.random.PRNGKey(0), conf, F32)
+    p["W"] = jnp.eye(2).reshape(1, 1, 2, 2)
+    p["b"] = jnp.zeros(2)
+    x = jax.random.normal(jax.random.PRNGKey(1), (1, 4, 4, 2))
+    y, _ = forward_layer(conf, p, x, LayerContext())
+    np.testing.assert_allclose(y, x, rtol=1e-5)
+
+
+def test_max_and_avg_pooling_values():
+    x = jnp.arange(16.0).reshape(1, 4, 4, 1)
+    mx = _mk(L.SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+    y, _ = forward_layer(mx, {}, x, LayerContext())
+    np.testing.assert_allclose(y[0, :, :, 0], [[5, 7], [13, 15]])
+    av = _mk(L.SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2), pooling_type="avg"))
+    y2, _ = forward_layer(av, {}, x, LayerContext())
+    np.testing.assert_allclose(y2[0, :, :, 0], [[2.5, 4.5], [10.5, 12.5]])
+
+
+def test_pnorm_pooling():
+    x = jnp.array([[3.0, 4.0], [0.0, 0.0]]).reshape(1, 2, 2, 1)
+    pn = _mk(L.SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2),
+                                pooling_type="pnorm", pnorm=2))
+    y, _ = forward_layer(pn, {}, x, LayerContext())
+    np.testing.assert_allclose(y[0, 0, 0, 0], 5.0, rtol=1e-6)
+
+
+def test_batchnorm_normalizes_and_tracks_stats():
+    conf = _mk(L.BatchNormalization(n_in=3))
+    p = init_layer_params(jax.random.PRNGKey(0), conf, F32)
+    st = init_layer_state(conf, F32)
+    x = 5.0 + 2.0 * jax.random.normal(jax.random.PRNGKey(1), (256, 3))
+    y, new_st = forward_layer(conf, p, x, LayerContext(training=True, state=st))
+    assert abs(float(jnp.mean(y))) < 0.1
+    assert abs(float(jnp.std(y)) - 1.0) < 0.1
+    # running stats moved toward batch stats: 0.9*0 + 0.1*mean(x)
+    np.testing.assert_allclose(new_st["mean"], 0.1 * jnp.mean(x, 0), rtol=1e-3)
+    # inference path uses provided stats
+    y_inf, none_st = forward_layer(conf, p, x, LayerContext(training=False, state=new_st))
+    assert none_st is None
+    assert y_inf.shape == x.shape
+
+
+def test_batchnorm_4d():
+    conf = _mk(L.BatchNormalization(n_in=4))
+    p = init_layer_params(jax.random.PRNGKey(0), conf, F32)
+    st = init_layer_state(conf, F32)
+    x = jax.random.normal(jax.random.PRNGKey(2), (8, 5, 5, 4)) * 3 + 1
+    y, _ = forward_layer(conf, p, x, LayerContext(training=True, state=st))
+    assert y.shape == x.shape
+    m = jnp.mean(y, axis=(0, 1, 2))
+    np.testing.assert_allclose(m, jnp.zeros(4), atol=0.05)
+
+
+def test_lrn_shape_and_scale_down():
+    conf = L.LocalResponseNormalization()
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 4, 4, 8))
+    y, _ = forward_layer(conf, {}, x, LayerContext())
+    assert y.shape == x.shape
+    # denominator >= k^beta > 1 for k=2 => |y| < |x|
+    assert float(jnp.max(jnp.abs(y))) < float(jnp.max(jnp.abs(x)))
+
+
+def test_embedding_lookup():
+    conf = _mk(L.EmbeddingLayer(n_in=10, n_out=4, activation="identity"))
+    p = init_layer_params(jax.random.PRNGKey(0), conf, F32)
+    idx = jnp.array([1, 3, 1])
+    y, _ = forward_layer(conf, p, idx, LayerContext())
+    assert y.shape == (3, 4)
+    np.testing.assert_allclose(y[0], y[2], rtol=1e-6)
+    np.testing.assert_allclose(y[0], p["W"][1] + p["b"], rtol=1e-6)
+
+
+def test_lstm_shapes_and_determinism():
+    conf = _mk(L.LSTM(n_in=6, n_out=5, activation="tanh"))
+    p = init_layer_params(jax.random.PRNGKey(0), conf, F32)
+    x = jax.random.normal(jax.random.PRNGKey(1), (3, 7, 6))
+    y, _ = forward_layer(conf, p, x, LayerContext())
+    assert y.shape == (3, 7, 5)
+    y2, _ = forward_layer(conf, p, x, LayerContext())
+    np.testing.assert_array_equal(np.asarray(y), np.asarray(y2))
+
+
+def test_graves_lstm_forward():
+    conf = _mk(L.GravesLSTM(n_in=4, n_out=3, activation="tanh"))
+    p = init_layer_params(jax.random.PRNGKey(0), conf, F32)
+    assert "pI" in p and "pF" in p and "pO" in p
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 5, 4))
+    y, _ = forward_layer(conf, p, x, LayerContext())
+    assert y.shape == (2, 5, 3)
+
+
+def test_lstm_masking_keeps_state_and_zeroes_output():
+    conf = _mk(L.LSTM(n_in=3, n_out=4, activation="tanh"))
+    p = init_layer_params(jax.random.PRNGKey(0), conf, F32)
+    x = jax.random.normal(jax.random.PRNGKey(1), (1, 6, 3))
+    mask = jnp.array([[1.0, 1.0, 1.0, 0.0, 0.0, 0.0]])
+    y, _ = forward_layer(conf, p, x, LayerContext(mask=mask))
+    # outputs at masked steps are exactly zero
+    np.testing.assert_array_equal(np.asarray(y[0, 3:]), np.zeros((3, 4)))
+    # truncating the sequence gives identical prefix outputs
+    y_short, _ = forward_layer(conf, p, x[:, :3], LayerContext())
+    np.testing.assert_allclose(np.asarray(y[0, :3]), np.asarray(y_short[0]), rtol=1e-5)
+
+
+def test_lstm_stateful_carry():
+    conf = _mk(L.LSTM(n_in=3, n_out=4, activation="tanh"))
+    p = init_layer_params(jax.random.PRNGKey(0), conf, F32)
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 8, 3))
+    # full pass
+    y_full, _ = forward_layer(conf, p, x, LayerContext())
+    # two halves with carried state == full pass
+    zeros = {"h": jnp.zeros((2, 4)), "c": jnp.zeros((2, 4))}
+    y1, st1 = forward_layer(conf, p, x[:, :4], LayerContext(state=zeros))
+    y2, _ = forward_layer(conf, p, x[:, 4:], LayerContext(state=st1))
+    np.testing.assert_allclose(np.asarray(y_full), np.asarray(jnp.concatenate([y1, y2], axis=1)), rtol=1e-5)
+
+
+def test_bidirectional_lstm_add_semantics():
+    conf = _mk(L.GravesBidirectionalLSTM(n_in=3, n_out=4, activation="tanh"))
+    p = init_layer_params(jax.random.PRNGKey(0), conf, F32)
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 5, 3))
+    y, _ = forward_layer(conf, p, x, LayerContext())
+    assert y.shape == (2, 5, 4)
+    # zeroing the backward params leaves the forward-only result
+    p0 = dict(p)
+    for k in list(p0):
+        if k.startswith("b_"):
+            p0[k] = jnp.zeros_like(p0[k])
+    y_fwd_only, _ = forward_layer(conf, p0, x, LayerContext())
+    # compare against a unidirectional GravesLSTM with the f_ params
+    uni = _mk(L.GravesLSTM(n_in=3, n_out=4, activation="tanh"))
+    pu = {k[2:]: v for k, v in p.items() if k.startswith("f_")}
+    yu, _ = forward_layer(uni, pu, x, LayerContext())
+    # backward pass with zero weights still contributes sigmoid(0)*tanh-ish
+    # outputs of zero (tanh(0)=0) so add leaves the forward result
+    np.testing.assert_allclose(np.asarray(y_fwd_only), np.asarray(yu), atol=1e-6)
+
+
+def test_global_pooling_cnn_and_rnn_masked():
+    gp = L.GlobalPoolingLayer(pooling_type="avg")
+    x4 = jnp.arange(8.0).reshape(1, 2, 2, 2)
+    y, _ = forward_layer(gp, {}, x4, LayerContext())
+    np.testing.assert_allclose(y, [[(0 + 2 + 4 + 6) / 4, (1 + 3 + 5 + 7) / 4]])
+    x3 = jnp.stack([jnp.ones((4, 3)), 2 * jnp.ones((4, 3))])  # [2,4,3]
+    mask = jnp.array([[1.0, 1.0, 0.0, 0.0], [1.0, 1.0, 1.0, 1.0]])
+    y2, _ = forward_layer(gp, {}, x3, LayerContext(mask=mask))
+    np.testing.assert_allclose(y2, [[1.0] * 3, [2.0] * 3])
+
+
+def test_zero_padding():
+    conf = L.ZeroPaddingLayer(padding=(1, 2, 3, 4))
+    x = jnp.ones((1, 5, 5, 2))
+    y, _ = forward_layer(conf, {}, x, LayerContext())
+    assert y.shape == (1, 8, 12, 2)
+    assert float(y[0, 0, 0, 0]) == 0.0
+
+
+def test_vae_forward_and_elbo():
+    conf = _mk(L.VariationalAutoencoder(
+        n_in=12, n_out=4, encoder_layer_sizes=[16], decoder_layer_sizes=[16],
+        pzx_activation="identity", activation="tanh"))
+    p = init_layer_params(jax.random.PRNGKey(0), conf, F32)
+    x = jax.random.uniform(jax.random.PRNGKey(1), (5, 12))
+    y, _ = forward_layer(conf, p, x, LayerContext())
+    assert y.shape == (5, 4)
+    from deeplearning4j_tpu.nn.layers.special import vae_elbo
+
+    elbo = vae_elbo(conf, p, x, jax.random.PRNGKey(2))
+    assert elbo.shape == (5,)
+    assert bool(jnp.all(jnp.isfinite(elbo)))
+
+
+def test_frozen_layer_delegates():
+    inner = _mk(L.DenseLayer(n_in=4, n_out=3, activation="identity", dropout=0.5))
+    conf = L.FrozenLayer(inner=inner)
+    p = init_layer_params(jax.random.PRNGKey(0), conf, F32)
+    x = jnp.ones((2, 4))
+    # frozen: no dropout even in training mode
+    y, _ = forward_layer(conf, p, x, LayerContext(training=True, rng=jax.random.PRNGKey(1)))
+    np.testing.assert_allclose(y, x @ p["W"] + p["b"], rtol=1e-6)
+
+
+def test_conv1d_and_subsampling1d():
+    conf = _mk(L.Convolution1DLayer(n_in=4, n_out=6, kernel_size=3, activation="relu"))
+    p = init_layer_params(jax.random.PRNGKey(0), conf, F32)
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 10, 4))
+    y, _ = forward_layer(conf, p, x, LayerContext())
+    assert y.shape == (2, 8, 6)
+    sub = L.Subsampling1DLayer(kernel_size=2, stride=2)
+    y2, _ = forward_layer(sub, {}, y, LayerContext())
+    assert y2.shape == (2, 4, 6)
