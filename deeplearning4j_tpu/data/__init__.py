@@ -1,1 +1,15 @@
-"""Subpackage."""
+"""Data pipeline: DataSet container, iterators, async prefetch, dataset
+fetchers.
+
+Analog of the reference's DataSet/DataSetIterator framework
+(deeplearning4j-nn datasets/ + deeplearning4j-core datasets/iterator/impl/).
+"""
+
+from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet
+from deeplearning4j_tpu.data.iterators import (
+    AsyncDataSetIterator,
+    DataSetIterator,
+    ExistingDataSetIterator,
+    ListDataSetIterator,
+    MultipleEpochsIterator,
+)

@@ -1,0 +1,54 @@
+"""DataSet containers.
+
+Analog of ND4J's DataSet/MultiDataSet (features, labels, optional masks) —
+the unit every iterator yields and fit() consumes. Arrays are host numpy
+until the train step moves them to HBM; the async iterator can pre-stage
+device transfers (reference: AsyncDataSetIterator device callbacks).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class DataSet:
+    features: np.ndarray
+    labels: np.ndarray
+    features_mask: Optional[np.ndarray] = None
+    labels_mask: Optional[np.ndarray] = None
+
+    def num_examples(self) -> int:
+        return int(self.features.shape[0])
+
+    def split_batches(self, batch_size: int) -> List["DataSet"]:
+        out = []
+        n = self.num_examples()
+        for i in range(0, n, batch_size):
+            sl = slice(i, min(i + batch_size, n))
+            out.append(
+                DataSet(
+                    self.features[sl],
+                    self.labels[sl],
+                    None if self.features_mask is None else self.features_mask[sl],
+                    None if self.labels_mask is None else self.labels_mask[sl],
+                )
+            )
+        return out
+
+
+@dataclasses.dataclass
+class MultiDataSet:
+    """Multiple inputs / multiple outputs (reference: ND4J MultiDataSet,
+    consumed by ComputationGraph.fit)."""
+
+    features: List[np.ndarray]
+    labels: List[np.ndarray]
+    features_masks: Optional[List[Optional[np.ndarray]]] = None
+    labels_masks: Optional[List[Optional[np.ndarray]]] = None
+
+    def num_examples(self) -> int:
+        return int(self.features[0].shape[0])

@@ -1,0 +1,150 @@
+"""DataSet iterators.
+
+Analog of the reference's iterator framework (datasets/iterator/):
+DataSetIterator SPI, ListDataSetIterator, ExistingDataSetIterator,
+MultipleEpochsIterator, and AsyncDataSetIterator — the background-prefetch
+wrapper MultiLayerNetwork.fit installs automatically
+(MultiLayerNetwork.java:1023-1025, prefetch threads feeding a bounded
+queue). Here prefetch threads stage host batches while the TPU runs the
+previous step, overlapping ETL with compute the same way.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterable, Iterator, List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.data.dataset import DataSet
+
+
+class DataSetIterator:
+    """SPI: iterable over DataSet minibatches with reset()."""
+
+    def __iter__(self) -> Iterator[DataSet]:
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        pass
+
+    def batch_size(self) -> Optional[int]:
+        return None
+
+    def total_examples(self) -> Optional[int]:
+        return None
+
+
+class ListDataSetIterator(DataSetIterator):
+    """Minibatches from in-memory arrays (reference:
+    ListDataSetIterator / ExistingDataSetIterator)."""
+
+    def __init__(self, dataset: DataSet, batch: int, shuffle: bool = False, seed: int = 0):
+        self.dataset = dataset
+        self.batch = batch
+        self.shuffle = shuffle
+        self._epoch = 0
+        self.seed = seed
+
+    def __iter__(self):
+        n = self.dataset.num_examples()
+        idx = np.arange(n)
+        if self.shuffle:
+            rng = np.random.default_rng(self.seed + self._epoch)
+            rng.shuffle(idx)
+        self._epoch += 1
+        d = self.dataset
+        for i in range(0, n, self.batch):
+            sl = idx[i : i + self.batch]
+            yield DataSet(
+                d.features[sl],
+                d.labels[sl],
+                None if d.features_mask is None else d.features_mask[sl],
+                None if d.labels_mask is None else d.labels_mask[sl],
+            )
+
+    def reset(self):
+        pass
+
+    def batch_size(self):
+        return self.batch
+
+    def total_examples(self):
+        return self.dataset.num_examples()
+
+
+class ExistingDataSetIterator(DataSetIterator):
+    """Wraps any iterable of DataSets (reference: ExistingDataSetIterator)."""
+
+    def __init__(self, datasets: Iterable[DataSet]):
+        self._list: List[DataSet] = list(datasets)
+
+    def __iter__(self):
+        return iter(self._list)
+
+    def total_examples(self):
+        return sum(d.num_examples() for d in self._list)
+
+
+class MultipleEpochsIterator(DataSetIterator):
+    """Repeat an underlying iterator n times (reference:
+    MultipleEpochsIterator.java)."""
+
+    def __init__(self, epochs: int, base: DataSetIterator):
+        self.epochs = epochs
+        self.base = base
+
+    def __iter__(self):
+        for _ in range(self.epochs):
+            self.base.reset()
+            yield from self.base
+
+    def batch_size(self):
+        return self.base.batch_size()
+
+
+_SENTINEL = object()
+
+
+class AsyncDataSetIterator(DataSetIterator):
+    """Background-thread prefetch with a bounded queue (reference:
+    AsyncDataSetIterator, queue capacity = prefetch buffer). The worker
+    thread performs ETL while the accelerator computes; exceptions propagate
+    to the consumer."""
+
+    def __init__(self, base: DataSetIterator, queue_size: int = 4):
+        self.base = base
+        self.queue_size = max(1, queue_size)
+
+    def __iter__(self):
+        q: "queue.Queue" = queue.Queue(maxsize=self.queue_size)
+        err: List[BaseException] = []
+
+        def worker():
+            try:
+                for ds in self.base:
+                    q.put(ds)
+            except BaseException as e:  # propagate to consumer
+                err.append(e)
+            finally:
+                q.put(_SENTINEL)
+
+        t = threading.Thread(target=worker, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is _SENTINEL:
+                if err:
+                    raise err[0]
+                return
+            yield item
+
+    def reset(self):
+        self.base.reset()
+
+    def batch_size(self):
+        return self.base.batch_size()
+
+    def total_examples(self):
+        return self.base.total_examples()

@@ -1,0 +1,533 @@
+"""MultiLayerNetwork — the sequential network.
+
+Analog of the reference's nn/multilayer/MultiLayerNetwork.java (2,853 LoC).
+The capability map (SURVEY.md §3.1) translates TPU-first:
+
+- reference: per-minibatch Solver.optimize -> feedForward (per-layer JNI
+  ops) -> backprop (hand-written) -> updater -> step.
+- here: ONE jitted train step = forward + loss + autodiff backward +
+  gradient normalization + updater + parameter update, compiled by XLA into
+  a single TPU program with donated buffers. Host code only feeds batches
+  and reads back the score when a listener asks.
+
+Parameters are a list of per-layer dicts (pytree); the flattened view
+(reference: flattenedParams, MultiLayerNetwork.java:102-104) is provided by
+nn/params.py for serialization/averaging APIs. Mutable non-trainable state
+(batchnorm running stats; LSTM h/c during TBPTT and rnnTimeStep streaming)
+is a parallel list, threaded functionally through the step.
+
+TBPTT (reference: :1074-1076, truncatedBPTTGradient :1333) segments the
+time axis host-side and carries RNN state between segment steps.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.common.dtypes import policy_from_name
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.data.iterators import (
+    AsyncDataSetIterator,
+    DataSetIterator,
+    ListDataSetIterator,
+)
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.nn.conf.network import BackpropType, MultiLayerConfiguration
+from deeplearning4j_tpu.nn.layers.registry import (
+    LayerContext,
+    forward_layer,
+    init_layer_params,
+    init_layer_state,
+)
+from deeplearning4j_tpu.nn.params import (
+    flat_to_params,
+    num_params,
+    param_table,
+    params_to_flat,
+)
+from deeplearning4j_tpu.ops.losses import loss_value
+from deeplearning4j_tpu.train.evaluation import Evaluation, RegressionEvaluation
+from deeplearning4j_tpu.train.updaters import (
+    normalize_gradients,
+    schedule_lr,
+    updater_from_conf,
+)
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+_OUTPUT_LAYER_TYPES = (L.OutputLayer, L.RnnOutputLayer, L.LossLayer,
+                       L.CenterLossOutputLayer)
+
+
+def _is_recurrent(conf) -> bool:
+    inner = conf.inner if isinstance(conf, L.FrozenLayer) else conf
+    return isinstance(inner, (L.LSTM, L.GravesLSTM))
+
+
+def _is_frozen(conf) -> bool:
+    return isinstance(conf, L.FrozenLayer)
+
+
+def _regularizable(name: str) -> bool:
+    """Weight-style params get l1/l2; biases and batchnorm affine params do
+    not (reference: each ParamInitializer flags regularizable params;
+    BatchNormalizationParamInitializer marks gamma/beta non-regularizable)."""
+    if name in ("gamma", "beta"):
+        return False
+    base = name.rsplit("_", 1)[-1]
+    return base in ("W", "RW", "pI", "pF", "pO")
+
+
+def _preout_of_output_layer(conf, params, x):
+    """Pre-activation of the final (output) layer — the quantity losses
+    consume (reference: BaseOutputLayer.preOutput2d)."""
+    if isinstance(conf, L.LossLayer):
+        return x
+    if isinstance(conf, L.RnnOutputLayer):
+        return jnp.einsum("bti,io->bto", x, params["W"]) + params["b"]
+    return x @ params["W"] + params["b"]
+
+
+class MultiLayerNetwork:
+    """Sequential network. API mirrors the reference: init, fit, output,
+    score, evaluate, params/set_params, rnn_time_step."""
+
+    def __init__(self, conf: MultiLayerConfiguration):
+        self.conf = conf
+        self.layer_confs: List[L.LayerConf] = list(conf.layers)
+        self.net_conf = conf.net_conf
+        self.policy = policy_from_name(self.net_conf.precision)
+        self.updater_def = updater_from_conf(self.net_conf)
+        self.listeners = []
+        self.iteration = 0
+        self.epoch = 0
+        self.params_list = None
+        self.state_list = None
+        self.upd_state = None
+        self._rnn_states = None  # streaming inference state (rnn_time_step)
+        self._train_step_fn = None
+        self._output_fn = None
+        self._score = None  # last minibatch score (device array, lazy read)
+        self._last_etl_ms = 0.0
+
+    # -- init ----------------------------------------------------------------
+
+    def init(self) -> "MultiLayerNetwork":
+        key = jax.random.PRNGKey(self.net_conf.seed)
+        dtype = self.policy.param_dtype
+        self.params_list = []
+        self.state_list = []
+        for i, conf in enumerate(self.layer_confs):
+            self.params_list.append(
+                init_layer_params(jax.random.fold_in(key, i), conf, dtype)
+            )
+            self.state_list.append(init_layer_state(conf, dtype))
+        self.upd_state = self.updater_def.init_tree(self.params_list)
+        return self
+
+    def _require_init(self):
+        if self.params_list is None:
+            self.init()
+
+    # -- listeners -----------------------------------------------------------
+
+    def set_listeners(self, *listeners):
+        self.listeners = list(listeners)
+        return self
+
+    def add_listener(self, listener):
+        self.listeners.append(listener)
+        return self
+
+    # -- forward -------------------------------------------------------------
+
+    def _forward(self, params, states, x, *, training, rng, f_mask=None,
+                 stateful=False, preout_last=False, to_layer=None):
+        """Pure forward. Returns (out, new_states). Used under jit."""
+        confs = self.layer_confs
+        pps = self.conf.preprocessors
+        new_states: List[Optional[dict]] = [None] * len(confs)
+        timesteps = x.shape[1] if x.ndim == 3 else None
+        n = len(confs) if to_layer is None else to_layer
+        for i in range(n):
+            conf = confs[i]
+            pp = pps.get(str(i))
+            if pp is not None:
+                x = pp(x, {"timesteps": timesteps})
+            if hasattr(x, "ndim") and x.ndim == 3:
+                timesteps = x.shape[1]
+            st = states[i]
+            if stateful and _is_recurrent(conf) and st is None:
+                st = {}  # empty dict triggers zero-state seed + state return
+            ctx = LayerContext(
+                training=training,
+                rng=jax.random.fold_in(rng, i) if rng is not None else None,
+                mask=f_mask if (hasattr(x, "ndim") and x.ndim == 3) else None,
+                timesteps=timesteps,
+                state=st,
+            )
+            is_last = i == len(confs) - 1
+            if preout_last and is_last and isinstance(conf, _OUTPUT_LAYER_TYPES):
+                x = _preout_of_output_layer(conf, params[i], x)
+                ns = None
+            else:
+                x, ns = forward_layer(conf, params[i], x, ctx)
+            new_states[i] = ns
+        return x, new_states
+
+    def _merge_states(self, old, new):
+        return [n if n is not None else o for o, n in zip(old, new)]
+
+    # -- loss ----------------------------------------------------------------
+
+    def _loss(self, params, states, x, y, f_mask, l_mask, rng, training=True):
+        last = self.layer_confs[-1]
+        if not isinstance(last, _OUTPUT_LAYER_TYPES):
+            raise ValueError(
+                "the final layer must be an OutputLayer/RnnOutputLayer/"
+                "LossLayer to compute a training loss"
+            )
+        x = self.policy.cast_input(x)
+        preout, new_states = self._forward(
+            params, states, x, training=training, rng=rng, f_mask=f_mask,
+            preout_last=True,
+        )
+        preout = self.policy.cast_output(preout)
+        per_ex = loss_value(last.loss, y, preout, last.activation, l_mask)
+        score = jnp.mean(per_ex)
+        # L1/L2 penalties (reference: BaseLayer.calcL1/calcL2 added to score;
+        # gradients come from differentiating this same expression)
+        reg = 0.0
+        for conf, p in zip(self.layer_confs, params):
+            inner = conf.inner if isinstance(conf, L.FrozenLayer) else conf
+            l1 = getattr(inner, "l1", 0.0) or 0.0
+            l2 = getattr(inner, "l2", 0.0) or 0.0
+            if l1 == 0.0 and l2 == 0.0:
+                continue
+            for name, w in p.items():
+                if _regularizable(name):
+                    if l1:
+                        reg = reg + l1 * jnp.sum(jnp.abs(w))
+                    if l2:
+                        reg = reg + 0.5 * l2 * jnp.sum(w * w)
+        return score + reg, new_states
+
+    # -- train step ----------------------------------------------------------
+
+    def _lr_mult_tree(self):
+        """Per-leaf learning-rate multiplier (per-layer learning_rate and
+        bias_learning_rate overrides, reference: layer conf learningRate)."""
+        base = self.net_conf.learning_rate
+        out = []
+        for conf, p in zip(self.layer_confs, self.params_list):
+            inner = conf.inner if isinstance(conf, L.FrozenLayer) else conf
+            layer_lr = getattr(inner, "learning_rate", None)
+            bias_lr = getattr(inner, "bias_learning_rate", None)
+            mult = {}
+            for name in p:
+                if name == "b" and bias_lr is not None:
+                    mult[name] = bias_lr / base
+                elif layer_lr is not None:
+                    mult[name] = layer_lr / base
+                else:
+                    mult[name] = 1.0
+            out.append(mult)
+        return out
+
+    def _trainable_mask(self):
+        return [
+            {k: (0.0 if _is_frozen(conf) else 1.0) for k in p}
+            for conf, p in zip(self.layer_confs, self.params_list)
+        ]
+
+    def _build_train_step(self):
+        gnorm = self.net_conf.gradient_normalization
+        gthresh = self.net_conf.gradient_normalization_threshold
+        mults = self._lr_mult_tree()
+        tmask = self._trainable_mask()
+        updater = self.updater_def
+        minimize = self.net_conf.minimize
+
+        def step(params, states, upd_state, x, y, f_mask, l_mask, lr, t, rng):
+            def loss_fn(p):
+                return self._loss(p, states, x, y, f_mask, l_mask, rng)
+
+            (score, new_states), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(params)
+            if not minimize:
+                grads = jax.tree_util.tree_map(lambda g: -g, grads)
+            grads = [
+                {k: g[k] * m[k] for k in g} for g, m in zip(grads, tmask)
+            ]
+            grads = normalize_gradients(grads, gnorm, gthresh)
+            lr_tree = [
+                {k: lr * m[k] for k in g} for g, m in zip(grads, mults)
+            ]
+            updates, new_upd = updater.apply_tree(grads, upd_state, lr_tree, t)
+            new_params = jax.tree_util.tree_map(jnp.add, params, updates)
+            merged = self._merge_states(states, new_states)
+            return new_params, merged, new_upd, score
+
+        backend = jax.default_backend()
+        donate = (0, 2) if backend != "cpu" else ()
+        return jax.jit(step, donate_argnums=donate)
+
+    def _fit_step(self, x, y, f_mask, l_mask, stateful_states=None):
+        """One optimizer step. Returns the (device) score."""
+        if self._train_step_fn is None:
+            self._train_step_fn = self._build_train_step()
+        lr = schedule_lr(self.net_conf, self.iteration)
+        rng = jax.random.fold_in(
+            jax.random.PRNGKey(self.net_conf.seed ^ 0x5EED), self.iteration
+        )
+        states = stateful_states if stateful_states is not None else self.state_list
+        params, states, upd, score = self._train_step_fn(
+            self.params_list, states, self.upd_state,
+            jnp.asarray(x), jnp.asarray(y),
+            None if f_mask is None else jnp.asarray(f_mask),
+            None if l_mask is None else jnp.asarray(l_mask),
+            jnp.asarray(lr, jnp.float32), jnp.asarray(float(self.iteration)),
+            rng,
+        )
+        self.params_list = params
+        self.upd_state = upd
+        self._score = score
+        self.iteration += 1
+        return states, score
+
+    # -- fit -----------------------------------------------------------------
+
+    def fit(self, data, labels=None, *, epochs: int = 1, batch_size: int = 32,
+            async_prefetch: bool = True):
+        """Train. Accepts (features, labels) arrays, a DataSet, or a
+        DataSetIterator (reference: MultiLayerNetwork.fit overloads
+        :1019)."""
+        self._require_init()
+        iterator = self._as_iterator(data, labels, batch_size)
+        if async_prefetch and not isinstance(iterator, AsyncDataSetIterator):
+            iterator = AsyncDataSetIterator(iterator)
+        for ep in range(epochs):
+            for lst in self.listeners:
+                lst.on_epoch_start(self, self.epoch)
+            t_etl = time.perf_counter()
+            for ds in iterator:
+                self._last_etl_ms = (time.perf_counter() - t_etl) * 1e3
+                self._fit_dataset(ds)
+                t_etl = time.perf_counter()
+            for lst in self.listeners:
+                lst.on_epoch_end(self, self.epoch)
+            self.epoch += 1
+            iterator.reset()
+        return self
+
+    def _as_iterator(self, data, labels, batch_size) -> DataSetIterator:
+        if isinstance(data, DataSetIterator):
+            return data
+        if isinstance(data, DataSet):
+            return ListDataSetIterator(data, batch_size)
+        x = np.asarray(data)
+        y = np.asarray(labels)
+        return ListDataSetIterator(DataSet(x, y), batch_size)
+
+    def _fit_dataset(self, ds: DataSet):
+        tbptt = (
+            self.conf.backprop_type == BackpropType.TRUNCATED_BPTT
+            and ds.features.ndim == 3
+        )
+        if tbptt:
+            self._fit_tbptt(ds)
+        else:
+            states, score = self._fit_step(
+                ds.features, ds.labels, ds.features_mask, ds.labels_mask
+            )
+            self.state_list = states
+            self._notify(ds.num_examples())
+
+    def _fit_tbptt(self, ds: DataSet):
+        """Truncated BPTT: split time into segments of tbptt_fwd_length and
+        carry RNN state across segments (reference:
+        MultiLayerNetwork.doTruncatedBPTT :1333)."""
+        T = ds.features.shape[1]
+        seg = int(self.conf.tbptt_fwd_length)
+        # seed zero RNN state for recurrent layers
+        states = list(self.state_list)
+        for i, conf in enumerate(self.layer_confs):
+            if _is_recurrent(conf) and states[i] is None:
+                states[i] = {}
+        for start in range(0, T, seg):
+            sl = slice(start, min(start + seg, T))
+            fm = None if ds.features_mask is None else ds.features_mask[:, sl]
+            lm = None if ds.labels_mask is None else ds.labels_mask[:, sl]
+            labels = ds.labels[:, sl] if ds.labels.ndim == 3 else ds.labels
+            states, _ = self._fit_step(
+                ds.features[:, sl], labels, fm, lm, stateful_states=states
+            )
+            self._notify(ds.num_examples())
+        # persist only non-RNN state (running stats); RNN carry is per-batch
+        self.state_list = [
+            st if not _is_recurrent(conf) else self.state_list[i]
+            for i, (conf, st) in enumerate(zip(self.layer_confs, states))
+        ]
+
+    def _notify(self, batch_size):
+        if not self.listeners:
+            return
+        info = {
+            "score": lambda: self._score,
+            "batch_size": batch_size,
+            "etl_ms": self._last_etl_ms,
+        }
+        for lst in self.listeners:
+            lst.iteration_done(self, self.iteration - 1, info)
+
+    # -- inference -----------------------------------------------------------
+
+    def output(self, x, training: bool = False):
+        """Full forward pass (reference: MultiLayerNetwork.output)."""
+        self._require_init()
+        if self._output_fn is None:
+            def fwd(params, states, xx):
+                xx = self.policy.cast_input(xx)
+                out, _ = self._forward(params, states, xx, training=False, rng=None)
+                return self.policy.cast_output(out)
+
+            self._output_fn = jax.jit(fwd)
+        return self._output_fn(self.params_list, self.state_list, jnp.asarray(x))
+
+    def feed_forward(self, x):
+        """Per-layer activations list (reference: feedForward family
+        :725-831). Not jitted — debugging/inspection path."""
+        self._require_init()
+        acts = []
+        xx = jnp.asarray(x)
+        timesteps = xx.shape[1] if xx.ndim == 3 else None
+        for i, conf in enumerate(self.layer_confs):
+            pp = self.conf.preprocessors.get(str(i))
+            if pp is not None:
+                xx = pp(xx, {"timesteps": timesteps})
+            if xx.ndim == 3:
+                timesteps = xx.shape[1]
+            ctx = LayerContext(training=False, state=self.state_list[i],
+                               timesteps=timesteps)
+            xx, _ = forward_layer(conf, self.params_list[i], xx, ctx)
+            acts.append(xx)
+        return acts
+
+    def score(self, data, labels=None) -> float:
+        """Loss on a dataset without updating (reference:
+        MultiLayerNetwork.score(DataSet))."""
+        self._require_init()
+        if isinstance(data, DataSet):
+            ds = data
+        else:
+            ds = DataSet(np.asarray(data), np.asarray(labels))
+        s, _ = self._loss(
+            self.params_list, self.state_list,
+            jnp.asarray(ds.features), jnp.asarray(ds.labels),
+            None if ds.features_mask is None else jnp.asarray(ds.features_mask),
+            None if ds.labels_mask is None else jnp.asarray(ds.labels_mask),
+            rng=None, training=False,
+        )
+        return float(s)
+
+    def evaluate(self, data, labels=None, batch_size: int = 256) -> Evaluation:
+        """Classification evaluation (reference: evaluate/doEvaluation
+        :2605-2646)."""
+        ev = Evaluation()
+        for ds in self._eval_batches(data, labels, batch_size):
+            out = self.output(ds.features)
+            ev.eval_batch(ds.labels, out, ds.labels_mask)
+        return ev
+
+    def evaluate_regression(self, data, labels=None, batch_size: int = 256):
+        ev = RegressionEvaluation()
+        for ds in self._eval_batches(data, labels, batch_size):
+            out = self.output(ds.features)
+            ev.eval_batch(ds.labels, out, ds.labels_mask)
+        return ev
+
+    def _eval_batches(self, data, labels, batch_size):
+        if isinstance(data, DataSetIterator):
+            yield from data
+        elif isinstance(data, DataSet):
+            yield from data.split_batches(batch_size)
+        else:
+            yield from DataSet(np.asarray(data), np.asarray(labels)).split_batches(batch_size)
+
+    # -- rnn streaming inference ---------------------------------------------
+
+    def rnn_time_step(self, x):
+        """Stateful streaming inference (reference:
+        MultiLayerNetwork.rnnTimeStep). x: [batch, time, nIn] (or
+        [batch, nIn] for a single step)."""
+        self._require_init()
+        xx = jnp.asarray(x)
+        single = xx.ndim == 2
+        if single:
+            xx = xx[:, None, :]
+        states = self._rnn_states
+        if states is None:
+            states = [
+                {} if _is_recurrent(c) else self.state_list[i]
+                for i, c in enumerate(self.layer_confs)
+            ]
+        out, new_states = self._forward(
+            self.params_list, states, self.policy.cast_input(xx),
+            training=False, rng=None, stateful=True,
+        )
+        self._rnn_states = self._merge_states(states, new_states)
+        out = self.policy.cast_output(out)
+        return out[:, 0] if single else out
+
+    def rnn_clear_previous_state(self):
+        self._rnn_states = None
+
+    # -- params API ----------------------------------------------------------
+
+    def params(self) -> jnp.ndarray:
+        """Flattened parameter vector (reference: Model.params())."""
+        self._require_init()
+        return params_to_flat(self.layer_confs, self.params_list)
+
+    def set_params(self, flat):
+        self._require_init()
+        self.params_list = flat_to_params(self.layer_confs, self.params_list, flat)
+
+    def num_params(self) -> int:
+        self._require_init()
+        return num_params(self.layer_confs, self.params_list)
+
+    def param_table(self):
+        self._require_init()
+        return param_table(self.layer_confs, self.params_list)
+
+    def summary(self) -> str:
+        self._require_init()
+        lines = ["=" * 70]
+        total = 0
+        for i, (conf, p) in enumerate(zip(self.layer_confs, self.params_list)):
+            n = sum(int(np.prod(v.shape)) for v in p.values())
+            total += n
+            lines.append(f"{i:>3}  {type(conf).__name__:<28} params: {n}")
+        lines.append(f"total params: {total}")
+        lines.append("=" * 70)
+        return "\n".join(lines)
+
+    def clone(self) -> "MultiLayerNetwork":
+        import copy
+
+        other = MultiLayerNetwork(copy.deepcopy(self.conf))
+        if self.params_list is not None:
+            other.init()
+            other.params_list = jax.tree_util.tree_map(lambda a: a, self.params_list)
+            other.state_list = [
+                None if s is None else dict(s) for s in self.state_list
+            ]
+        return other

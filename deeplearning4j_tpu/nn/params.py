@@ -1,0 +1,75 @@
+"""Flattened parameter views.
+
+The reference's load-bearing design: one flat buffer for all params
+(MultiLayerNetwork.java:102-104 flattenedParams/flattenedGradients), with
+each layer's ParamInitializer defining its slice layout (nn/params/*). Here
+parameters natively live as a pytree (list of per-layer dicts) — XLA needs
+no flat buffer for fused updates — but the flat view remains the API for
+serialization (coefficients.bin), parameter averaging and params()/
+setParams() compatibility.
+
+Flattening order: layer index ascending, then the layer's param_order()
+names, each tensor row-major. Deterministic across processes and device
+counts.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nn.layers.registry import param_order
+
+
+def num_params(layer_confs, params_list) -> int:
+    return sum(
+        int(np.prod(p[name].shape))
+        for conf, p in zip(layer_confs, params_list)
+        for name in param_order(conf)
+        if name in p
+    )
+
+
+def params_to_flat(layer_confs, params_list) -> jnp.ndarray:
+    """Concatenate all parameters into one 1-D vector (reference:
+    flattenedParams view order)."""
+    chunks = []
+    for conf, p in zip(layer_confs, params_list):
+        for name in param_order(conf):
+            if name in p:
+                chunks.append(jnp.ravel(p[name]))
+    if not chunks:
+        return jnp.zeros((0,), jnp.float32)
+    return jnp.concatenate(chunks)
+
+
+def flat_to_params(layer_confs, params_list, flat) -> List[Dict]:
+    """Inverse of params_to_flat: scatter a flat vector back into a pytree
+    with the same shapes as params_list."""
+    out = []
+    off = 0
+    flat = jnp.asarray(flat)
+    for conf, p in zip(layer_confs, params_list):
+        new = dict(p)
+        for name in param_order(conf):
+            if name in p:
+                n = int(np.prod(p[name].shape))
+                new[name] = flat[off : off + n].reshape(p[name].shape).astype(p[name].dtype)
+                off += n
+        out.append(new)
+    if off != flat.shape[0]:
+        raise ValueError(f"flat vector length {flat.shape[0]} != model params {off}")
+    return out
+
+
+def param_table(layer_confs, params_list) -> List[Tuple[str, Tuple[int, ...], int]]:
+    """[(qualified_name, shape, size)] in flattening order — the analog of
+    the reference's paramTable() keys like '0_W', '1_b'."""
+    rows = []
+    for i, (conf, p) in enumerate(zip(layer_confs, params_list)):
+        for name in param_order(conf):
+            if name in p:
+                rows.append((f"{i}_{name}", tuple(p[name].shape), int(np.prod(p[name].shape))))
+    return rows

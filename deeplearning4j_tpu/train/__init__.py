@@ -1,1 +1,7 @@
-"""Subpackage."""
+"""Training stack: updaters, gradient normalization, listeners, evaluation,
+early stopping, gradient checks.
+
+Analog of the reference's optimize/ + nn/updater/ + eval/ + earlystopping/
+subsystems (SURVEY.md §2.1), collapsed into pure functions that live inside
+one jitted train step instead of a Solver/Updater object graph.
+"""

@@ -1,0 +1,114 @@
+"""Training listeners.
+
+Analog of the reference's IterationListener/TrainingListener SPI
+(optimize/api/, optimize/listeners/): ScoreIterationListener,
+PerformanceListener (samples/sec + ETL time), CollectScoresIterationListener,
+EvaluativeListener. The listener callback receives a small info dict; score
+is fetched as a host scalar only when a listener actually wants it, so
+listeners do not force device syncs on every step.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Callable, List, Optional
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+
+class IterationListener:
+    """SPI (reference: optimize/api/IterationListener.java)."""
+
+    def iteration_done(self, model, iteration: int, info: dict) -> None:
+        raise NotImplementedError
+
+    def on_epoch_start(self, model, epoch: int) -> None:
+        pass
+
+    def on_epoch_end(self, model, epoch: int) -> None:
+        pass
+
+
+class ScoreIterationListener(IterationListener):
+    """Log the score every `frequency` iterations (reference:
+    optimize/listeners/ScoreIterationListener.java)."""
+
+    def __init__(self, frequency: int = 10, print_fn: Optional[Callable] = None):
+        self.frequency = max(1, frequency)
+        self.print_fn = print_fn or (lambda s: logger.info(s))
+
+    def iteration_done(self, model, iteration, info):
+        if iteration % self.frequency == 0:
+            score = float(info["score"]())
+            self.print_fn(f"Score at iteration {iteration} is {score}")
+
+
+class PerformanceListener(IterationListener):
+    """Throughput listener (reference: PerformanceListener.java — iterations
+    /sec, samples/sec, ETL time)."""
+
+    def __init__(self, frequency: int = 10, print_fn: Optional[Callable] = None):
+        self.frequency = max(1, frequency)
+        self.print_fn = print_fn or (lambda s: logger.info(s))
+        self._last_time = None
+        self._samples = 0
+        self._iters = 0
+
+    def iteration_done(self, model, iteration, info):
+        now = time.perf_counter()
+        self._samples += info.get("batch_size", 0)
+        self._iters += 1
+        if self._last_time is None:
+            self._last_time = now
+            return
+        if self._iters % self.frequency == 0:
+            dt = now - self._last_time
+            if dt > 0:
+                self.print_fn(
+                    f"iter {iteration}: {self._iters / dt:.1f} it/s, "
+                    f"{self._samples / dt:.1f} samples/s, "
+                    f"etl {info.get('etl_ms', 0.0):.1f} ms"
+                )
+            self._last_time = now
+            self._samples = 0
+            self._iters = 0
+
+
+class CollectScoresIterationListener(IterationListener):
+    """Accumulate (iteration, score) pairs (reference:
+    CollectScoresIterationListener.java)."""
+
+    def __init__(self, frequency: int = 1):
+        self.frequency = max(1, frequency)
+        self.scores: List[tuple] = []
+
+    def iteration_done(self, model, iteration, info):
+        if iteration % self.frequency == 0:
+            self.scores.append((iteration, float(info["score"]())))
+
+
+class EvaluativeListener(IterationListener):
+    """Periodically evaluate on a held-out set (reference:
+    EvaluativeListener.java)."""
+
+    def __init__(self, data_iterator, frequency: int = 100, print_fn=None):
+        self.iterator = data_iterator
+        self.frequency = max(1, frequency)
+        self.print_fn = print_fn or (lambda s: logger.info(s))
+        self.last_evaluation = None
+
+    def iteration_done(self, model, iteration, info):
+        if iteration > 0 and iteration % self.frequency == 0:
+            ev = model.evaluate(self.iterator)
+            self.last_evaluation = ev
+            self.print_fn(f"iter {iteration}: accuracy={ev.accuracy():.4f}")
+
+
+class ComposableIterationListener(IterationListener):
+    def __init__(self, *listeners):
+        self.listeners = list(listeners)
+
+    def iteration_done(self, model, iteration, info):
+        for listener in self.listeners:
+            listener.iteration_done(model, iteration, info)

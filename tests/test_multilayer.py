@@ -1,0 +1,164 @@
+"""MultiLayerNetwork training tests (reference:
+deeplearning4j-core nn/multilayer/MultiLayerTest, BackPropMLPTest)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data import DataSet, ListDataSetIterator
+from deeplearning4j_tpu.nn.conf import (
+    DenseLayer,
+    InputType,
+    MultiLayerConfiguration,
+    NeuralNetConfiguration,
+    OutputLayer,
+    Updater,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.train.listeners import CollectScoresIterationListener
+
+
+def blobs(n=256, seed=0):
+    """Two gaussian blobs, linearly separable-ish."""
+    rng = np.random.default_rng(seed)
+    half = n // 2
+    x0 = rng.normal(loc=-1.5, scale=1.0, size=(half, 2))
+    x1 = rng.normal(loc=+1.5, scale=1.0, size=(half, 2))
+    x = np.concatenate([x0, x1]).astype(np.float32)
+    y = np.zeros((n, 2), np.float32)
+    y[:half, 0] = 1
+    y[half:, 1] = 1
+    idx = rng.permutation(n)
+    return x[idx], y[idx]
+
+
+def mlp_conf(updater=Updater.SGD, lr=0.5, **kw):
+    b = (
+        NeuralNetConfiguration.builder()
+        .seed(42)
+        .updater(updater)
+        .learning_rate(lr)
+    )
+    for k, v in kw.items():
+        b = getattr(b, k)(v)
+    return (
+        b.list()
+        .layer(DenseLayer(n_in=2, n_out=16, activation="tanh"))
+        .layer(OutputLayer(n_in=16, n_out=2, activation="softmax", loss="mcxent"))
+        .build()
+    )
+
+
+def test_init_and_param_count():
+    net = MultiLayerNetwork(mlp_conf()).init()
+    # (2*16 + 16) + (16*2 + 2) = 48 + 34
+    assert net.num_params() == 82
+    assert net.params().shape == (82,)
+    names = [r[0] for r in net.param_table()]
+    assert names == ["0_W", "0_b", "1_W", "1_b"]
+
+
+def test_params_roundtrip():
+    net = MultiLayerNetwork(mlp_conf()).init()
+    flat = net.params()
+    net2 = MultiLayerNetwork(mlp_conf()).init()
+    net2.set_params(flat)
+    np.testing.assert_array_equal(np.asarray(net2.params()), np.asarray(flat))
+    x = np.random.default_rng(0).normal(size=(4, 2)).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(net.output(x)), np.asarray(net2.output(x)), rtol=1e-6
+    )
+
+
+def test_deterministic_init_by_seed():
+    n1 = MultiLayerNetwork(mlp_conf()).init()
+    n2 = MultiLayerNetwork(mlp_conf()).init()
+    np.testing.assert_array_equal(np.asarray(n1.params()), np.asarray(n2.params()))
+
+
+def test_training_reduces_score_and_learns():
+    x, y = blobs()
+    net = MultiLayerNetwork(mlp_conf()).init()
+    s0 = net.score(x, y)
+    net.fit(x, y, epochs=20, batch_size=64, async_prefetch=False)
+    s1 = net.score(x, y)
+    assert s1 < s0 * 0.5
+    ev = net.evaluate(x, y)
+    assert ev.accuracy() > 0.9
+    assert ev.f1() > 0.9
+
+
+@pytest.mark.parametrize("updater", ["sgd", "nesterovs", "adam", "adamax",
+                                     "adadelta", "adagrad", "rmsprop"])
+def test_all_updaters_learn(updater):
+    x, y = blobs(128)
+    lr = {"adadelta": 1.0, "adam": 0.05, "adamax": 0.05, "adagrad": 0.2,
+          "rmsprop": 0.02}.get(updater, 0.5)
+    net = MultiLayerNetwork(mlp_conf(updater=updater, lr=lr)).init()
+    s0 = net.score(x, y)
+    net.fit(x, y, epochs=15, batch_size=64, async_prefetch=False)
+    assert net.score(x, y) < s0
+
+
+def test_fit_with_iterator_and_listener():
+    x, y = blobs(128)
+    it = ListDataSetIterator(DataSet(x, y), batch=32, shuffle=True)
+    net = MultiLayerNetwork(mlp_conf()).init()
+    collector = CollectScoresIterationListener()
+    net.set_listeners(collector)
+    net.fit(it, epochs=3, async_prefetch=True)
+    assert len(collector.scores) == 12  # 4 batches x 3 epochs
+    assert collector.scores[-1][1] < collector.scores[0][1]
+
+
+def test_l2_regularization_changes_training():
+    x, y = blobs(128)
+    net_plain = MultiLayerNetwork(mlp_conf()).init()
+    net_reg = MultiLayerNetwork(mlp_conf(l2=0.1)).init()
+    net_plain.fit(x, y, epochs=10, batch_size=128, async_prefetch=False)
+    net_reg.fit(x, y, epochs=10, batch_size=128, async_prefetch=False)
+    wn_plain = float(jnp.linalg.norm(net_plain.params_list[0]["W"]))
+    wn_reg = float(jnp.linalg.norm(net_reg.params_list[0]["W"]))
+    assert wn_reg < wn_plain  # weight decay shrinks weights
+
+
+def test_gradient_clipping_runs():
+    x, y = blobs(64)
+    conf = mlp_conf(
+        gradient_normalization="clip_l2_per_layer",
+        gradient_normalization_threshold=0.5,
+    )
+    net = MultiLayerNetwork(conf).init()
+    s0 = net.score(x, y)
+    net.fit(x, y, epochs=10, batch_size=64, async_prefetch=False)
+    assert net.score(x, y) < s0
+
+
+def test_lr_schedule_applied():
+    from deeplearning4j_tpu.train.updaters import schedule_lr
+
+    conf = (
+        NeuralNetConfiguration.builder()
+        .learning_rate(0.1)
+        .learning_rate_schedule({0: 0.1, 5: 0.01, 10: 0.001})
+        .build()
+    )
+    assert schedule_lr(conf, 0) == 0.1
+    assert schedule_lr(conf, 7) == 0.01
+    assert schedule_lr(conf, 50) == 0.001
+
+
+def test_output_probabilities_sum_to_one():
+    x, y = blobs(32)
+    net = MultiLayerNetwork(mlp_conf()).init()
+    out = net.output(x)
+    np.testing.assert_allclose(np.asarray(jnp.sum(out, -1)), np.ones(32), atol=1e-5)
+
+
+def test_score_matches_manual_crossentropy():
+    x, y = blobs(16)
+    net = MultiLayerNetwork(mlp_conf()).init()
+    out = np.asarray(net.output(x))
+    manual = -np.mean(np.sum(y * np.log(np.clip(out, 1e-8, None)), axis=-1))
+    assert abs(net.score(x, y) - manual) < 1e-4

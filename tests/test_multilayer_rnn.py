@@ -1,0 +1,103 @@
+"""RNN network tests: sequence classification, TBPTT, rnn_time_step
+(reference: MultiLayerTestRNN, TestVariableLengthTS)."""
+
+import numpy as np
+
+from deeplearning4j_tpu.data import DataSet
+from deeplearning4j_tpu.nn.conf import (
+    GravesLSTM,
+    InputType,
+    LSTM,
+    NeuralNetConfiguration,
+    RnnOutputLayer,
+)
+from deeplearning4j_tpu.nn.conf.network import BackpropType
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+def seq_data(n=64, t=12, seed=0):
+    """Predict sign of running sum of inputs (time-distributed 2-class)."""
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, t, 1)).astype(np.float32)
+    cs = np.cumsum(x[..., 0], axis=1)
+    y = np.zeros((n, t, 2), np.float32)
+    y[..., 0] = (cs <= 0).astype(np.float32)
+    y[..., 1] = (cs > 0).astype(np.float32)
+    return x, y
+
+
+def rnn_conf(cell=LSTM, tbptt=False):
+    lb = (
+        NeuralNetConfiguration.builder()
+        .seed(7)
+        .updater("adam")
+        .learning_rate(0.02)
+        .list()
+        .layer(cell(n_out=16, activation="tanh"))
+        .layer(RnnOutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+        .set_input_type(InputType.recurrent(1))
+    )
+    if tbptt:
+        lb = lb.backprop_type(BackpropType.TRUNCATED_BPTT).t_bptt_lengths(4)
+    return lb.build()
+
+
+def test_lstm_sequence_classification_learns():
+    x, y = seq_data()
+    net = MultiLayerNetwork(rnn_conf()).init()
+    s0 = net.score(x, y)
+    net.fit(x, y, epochs=20, batch_size=32, async_prefetch=False)
+    s1 = net.score(x, y)
+    assert s1 < s0 * 0.8
+    ev = net.evaluate(x, y)
+    assert ev.accuracy() > 0.7
+
+
+def test_graves_lstm_learns():
+    x, y = seq_data(48, 8)
+    net = MultiLayerNetwork(rnn_conf(cell=GravesLSTM)).init()
+    s0 = net.score(x, y)
+    net.fit(x, y, epochs=10, batch_size=48, async_prefetch=False)
+    assert net.score(x, y) < s0
+
+
+def test_tbptt_training_runs_and_learns():
+    x, y = seq_data(32, 16)
+    net = MultiLayerNetwork(rnn_conf(tbptt=True)).init()
+    s0 = net.score(x, y)
+    net.fit(x, y, epochs=10, batch_size=32, async_prefetch=False)
+    assert net.score(x, y) < s0
+    # 16 timesteps / tbptt 4 = 4 optimizer steps per batch
+    assert net.iteration == 10 * 4
+
+
+def test_rnn_time_step_matches_full_forward():
+    x, y = seq_data(4, 6)
+    net = MultiLayerNetwork(rnn_conf()).init()
+    full = np.asarray(net.output(x))
+    net.rnn_clear_previous_state()
+    step1 = np.asarray(net.rnn_time_step(x[:, :3]))
+    step2 = np.asarray(net.rnn_time_step(x[:, 3:]))
+    streamed = np.concatenate([step1, step2], axis=1)
+    np.testing.assert_allclose(full, streamed, atol=1e-5)
+    # single-step 2d input
+    net.rnn_clear_previous_state()
+    s = np.asarray(net.rnn_time_step(x[:, 0]))
+    np.testing.assert_allclose(s, full[:, 0], atol=1e-5)
+
+
+def test_variable_length_masking():
+    x, y = seq_data(16, 10)
+    mask = np.ones((16, 10), np.float32)
+    mask[:, 7:] = 0  # last 3 steps padding
+    ds = DataSet(x, y, features_mask=mask, labels_mask=mask)
+    net = MultiLayerNetwork(rnn_conf()).init()
+    s0 = net.score(ds)
+    net.fit(ds, epochs=5, batch_size=16, async_prefetch=False)
+    assert net.score(ds) < s0
+    # masked-out steps must not influence the loss: perturbing padded input
+    # leaves the score unchanged
+    x2 = x.copy()
+    x2[:, 7:] += 100.0
+    ds2 = DataSet(x2, y, features_mask=mask, labels_mask=mask)
+    assert abs(net.score(ds2) - net.score(ds)) < 1e-5
