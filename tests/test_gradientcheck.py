@@ -1,0 +1,222 @@
+"""Gradient checks — the correctness backbone (reference:
+deeplearning4j-core gradientcheck/ — GradientCheckTests,
+CNNGradientCheckTest, LSTMGradientCheckTests, BNGradientCheckTest,
+LossFunctionGradientCheck — run at eps=1e-6 in double precision)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.conf import (
+    ActivationLayer,
+    AutoEncoder,
+    BatchNormalization,
+    ConvolutionLayer,
+    DenseLayer,
+    EmbeddingLayer,
+    GlobalPoolingLayer,
+    GravesBidirectionalLSTM,
+    GravesLSTM,
+    InputType,
+    LSTM,
+    NeuralNetConfiguration,
+    OutputLayer,
+    RnnOutputLayer,
+    SubsamplingLayer,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.train.gradientcheck import check_gradients
+
+RNG = np.random.default_rng(12345)
+
+
+def _labels(n, c):
+    y = np.zeros((n, c))
+    y[np.arange(n), RNG.integers(0, c, n)] = 1.0
+    return y
+
+
+def _rnn_labels(n, t, c):
+    y = np.zeros((n, t, c))
+    idx = RNG.integers(0, c, (n, t))
+    for i in range(n):
+        y[i, np.arange(t), idx[i]] = 1.0
+    return y
+
+
+# smooth activations only (reference whitelist GradientCheckUtil.java:48-59)
+@pytest.mark.parametrize("act,loss,out_act", [
+    ("tanh", "mcxent", "softmax"),
+    ("sigmoid", "mse", "identity"),
+    ("softplus", "mcxent", "softmax"),
+    ("cube", "mse", "tanh"),
+    ("softsign", "xent", "sigmoid"),
+    ("elu", "mse", "identity"),
+])
+def test_mlp_gradients(act, loss, out_act):
+    conf = (
+        NeuralNetConfiguration.builder()
+        .seed(12345)
+        .list()
+        .layer(DenseLayer(n_in=4, n_out=5, activation=act))
+        .layer(OutputLayer(n_out=3, activation=out_act, loss=loss))
+        .build()
+    )
+    net = MultiLayerNetwork(conf).init()
+    x = RNG.normal(size=(6, 4))
+    if loss == "xent":
+        y = RNG.uniform(0.1, 0.9, size=(6, 3))
+    else:
+        y = _labels(6, 3)
+    assert check_gradients(net, x, y, verbose=True)
+
+
+def test_mlp_with_l1_l2_gradients():
+    conf = (
+        NeuralNetConfiguration.builder()
+        .seed(12345)
+        .l1(0.01)
+        .l2(0.02)
+        .list()
+        .layer(DenseLayer(n_in=4, n_out=5, activation="tanh"))
+        .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+        .build()
+    )
+    net = MultiLayerNetwork(conf).init()
+    # keep weights away from 0 so |w| is differentiable at the check points
+    x = RNG.normal(size=(5, 4))
+    y = _labels(5, 3)
+    assert check_gradients(net, x, y)
+
+
+def test_cnn_gradients():
+    conf = (
+        NeuralNetConfiguration.builder()
+        .seed(12345)
+        .list()
+        .layer(ConvolutionLayer(kernel_size=(2, 2), n_out=3, activation="tanh"))
+        .layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2), pooling_type="avg"))
+        .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+        .set_input_type(InputType.convolutional(6, 6, 2))
+        .build()
+    )
+    net = MultiLayerNetwork(conf).init()
+    x = RNG.normal(size=(4, 6, 6, 2))
+    y = _labels(4, 2)
+    assert check_gradients(net, x, y, verbose=True)
+
+
+def test_cnn_max_pool_gradients():
+    # max pool is piecewise-linear; fine for gradient checks away from ties
+    conf = (
+        NeuralNetConfiguration.builder()
+        .seed(99)
+        .list()
+        .layer(ConvolutionLayer(kernel_size=(3, 3), n_out=2, activation="sigmoid"))
+        .layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+        .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+        .set_input_type(InputType.convolutional(7, 7, 1))
+        .build()
+    )
+    net = MultiLayerNetwork(conf).init()
+    x = RNG.normal(size=(3, 7, 7, 1))
+    y = _labels(3, 2)
+    assert check_gradients(net, x, y)
+
+
+def test_batchnorm_gradients():
+    conf = (
+        NeuralNetConfiguration.builder()
+        .seed(12345)
+        .list()
+        .layer(DenseLayer(n_in=4, n_out=6, activation="tanh"))
+        .layer(BatchNormalization())
+        .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+        .set_input_type(InputType.feed_forward(4))
+        .build()
+    )
+    net = MultiLayerNetwork(conf).init()
+    x = RNG.normal(size=(8, 4))
+    y = _labels(8, 3)
+    assert check_gradients(net, x, y, verbose=True)
+
+
+def test_lstm_gradients():
+    conf = (
+        NeuralNetConfiguration.builder()
+        .seed(12345)
+        .list()
+        .layer(LSTM(n_out=4, activation="tanh"))
+        .layer(RnnOutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+        .set_input_type(InputType.recurrent(3))
+        .build()
+    )
+    net = MultiLayerNetwork(conf).init()
+    x = RNG.normal(size=(3, 5, 3))
+    y = _rnn_labels(3, 5, 2)
+    assert check_gradients(net, x, y, verbose=True)
+
+
+def test_graves_lstm_gradients_with_mask():
+    conf = (
+        NeuralNetConfiguration.builder()
+        .seed(12345)
+        .list()
+        .layer(GravesLSTM(n_out=3, activation="tanh"))
+        .layer(RnnOutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+        .set_input_type(InputType.recurrent(2))
+        .build()
+    )
+    net = MultiLayerNetwork(conf).init()
+    x = RNG.normal(size=(2, 6, 2))
+    y = _rnn_labels(2, 6, 2)
+    mask = np.ones((2, 6))
+    mask[0, 4:] = 0
+    mask[1, 5:] = 0
+    assert check_gradients(net, x, y, features_mask=mask, labels_mask=mask)
+
+
+def test_bidirectional_lstm_gradients():
+    conf = (
+        NeuralNetConfiguration.builder()
+        .seed(5)
+        .list()
+        .layer(GravesBidirectionalLSTM(n_out=3, activation="tanh"))
+        .layer(GlobalPoolingLayer(pooling_type="avg"))
+        .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+        .set_input_type(InputType.recurrent(2))
+        .build()
+    )
+    net = MultiLayerNetwork(conf).init()
+    x = RNG.normal(size=(2, 4, 2))
+    y = _labels(2, 2)
+    assert check_gradients(net, x, y)
+
+
+def test_embedding_gradients():
+    conf = (
+        NeuralNetConfiguration.builder()
+        .seed(12345)
+        .list()
+        .layer(EmbeddingLayer(n_in=7, n_out=4, activation="tanh"))
+        .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+        .build()
+    )
+    net = MultiLayerNetwork(conf).init()
+    x = RNG.integers(0, 7, size=(6, 1)).astype(np.float64)
+    y = _labels(6, 3)
+    assert check_gradients(net, x, y)
+
+
+def test_autoencoder_supervised_gradients():
+    conf = (
+        NeuralNetConfiguration.builder()
+        .seed(3)
+        .list()
+        .layer(AutoEncoder(n_in=5, n_out=4, activation="sigmoid"))
+        .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+        .build()
+    )
+    net = MultiLayerNetwork(conf).init()
+    x = RNG.normal(size=(4, 5))
+    y = _labels(4, 2)
+    assert check_gradients(net, x, y)
