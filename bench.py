@@ -1,0 +1,61 @@
+"""Benchmark entry point — prints ONE JSON line with the headline metric.
+
+Current flagship: LeNet-MNIST training throughput (images/sec/chip) on the
+default backend (TPU under axon; CPU elsewhere). Will switch to ResNet-50
+images/sec/chip (BASELINE.md metric of record) once the ComputationGraph
+workload lands. The reference publishes no numbers (BASELINE.json
+published={}), so vs_baseline is reported as 1.0 by convention.
+
+Protocol (BASELINE.md): synthetic data (BenchmarkDataSetIterator-equivalent)
+to remove ETL noise; steady-state steps timed after warmup/compile;
+per-chip batch; bf16 compute policy on TPU.
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def bench_lenet(batch: int = 512, steps: int = 30, warmup: int = 5) -> dict:
+    from deeplearning4j_tpu.models.lenet import lenet_network
+
+    on_tpu = jax.default_backend() not in ("cpu",)
+    net = lenet_network(precision="bf16" if on_tpu else "f32")
+
+    rng = np.random.default_rng(0)
+    x = rng.random((batch, 784), np.float32)
+    y = np.zeros((batch, 10), np.float32)
+    y[np.arange(batch), rng.integers(0, 10, batch)] = 1.0
+
+    # warmup (includes compile)
+    for _ in range(warmup):
+        states, score = net._fit_step(x, y, None, None)
+        net.state_list = states
+    jax.block_until_ready(net.params_list)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        states, score = net._fit_step(x, y, None, None)
+        net.state_list = states
+    jax.block_until_ready(net.params_list)
+    dt = time.perf_counter() - t0
+
+    ips = batch * steps / dt
+    return {
+        "metric": "lenet_mnist_train_images_per_sec_per_chip",
+        "value": round(ips, 1),
+        "unit": "images/sec/chip",
+        "vs_baseline": 1.0,
+        "backend": jax.default_backend(),
+        "batch": batch,
+        "steps": steps,
+        "seconds": round(dt, 3),
+    }
+
+
+if __name__ == "__main__":
+    result = bench_lenet()
+    print(json.dumps(result))

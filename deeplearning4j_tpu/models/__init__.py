@@ -1,1 +1,5 @@
-"""Subpackage."""
+"""Model zoo: reference workload architectures built on the config DSL
+(BASELINE.md configs: LeNet-MNIST, ResNet-50, VGG16, GravesLSTM char-rnn).
+"""
+
+from deeplearning4j_tpu.models.lenet import lenet_conf, lenet_network

@@ -1,0 +1,47 @@
+"""LeNet for MNIST — the minimum end-to-end workload (SURVEY.md §7 stage 6;
+reference workload: BASELINE.md "LeNet MNIST MultiLayerNetwork", the
+dl4j-examples LenetMnistExample architecture: conv5x5x20 - maxpool2 -
+conv5x5x50 - maxpool2 - dense500 relu - softmax10)."""
+
+from __future__ import annotations
+
+from deeplearning4j_tpu.nn.conf import (
+    ConvolutionLayer,
+    DenseLayer,
+    InputType,
+    MultiLayerConfiguration,
+    NeuralNetConfiguration,
+    OutputLayer,
+    SubsamplingLayer,
+    Updater,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+def lenet_conf(seed: int = 123, learning_rate: float = 0.01,
+               precision: str = "f32") -> MultiLayerConfiguration:
+    return (
+        NeuralNetConfiguration.builder()
+        .seed(seed)
+        .updater(Updater.NESTEROVS)
+        .learning_rate(learning_rate)
+        .momentum(0.9)
+        .weight_init("xavier")
+        .precision(precision)
+        .list()
+        .layer(ConvolutionLayer(kernel_size=(5, 5), stride=(1, 1), n_out=20,
+                                activation="identity"))
+        .layer(SubsamplingLayer(pooling_type="max", kernel_size=(2, 2), stride=(2, 2)))
+        .layer(ConvolutionLayer(kernel_size=(5, 5), stride=(1, 1), n_out=50,
+                                activation="identity"))
+        .layer(SubsamplingLayer(pooling_type="max", kernel_size=(2, 2), stride=(2, 2)))
+        .layer(DenseLayer(n_out=500, activation="relu"))
+        .layer(OutputLayer(n_out=10, activation="softmax", loss="mcxent"))
+        .set_input_type(InputType.convolutional_flat(28, 28, 1))
+        .build()
+    )
+
+
+def lenet_network(seed: int = 123, learning_rate: float = 0.01,
+                  precision: str = "f32") -> MultiLayerNetwork:
+    return MultiLayerNetwork(lenet_conf(seed, learning_rate, precision)).init()
