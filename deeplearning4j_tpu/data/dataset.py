@@ -24,6 +24,28 @@ class DataSet:
     def num_examples(self) -> int:
         return int(self.features.shape[0])
 
+    @staticmethod
+    def concat(batches: List["DataSet"]) -> "DataSet":
+        """Concatenate along the example axis (masks must be uniformly
+        present or absent)."""
+        if len(batches) == 1:
+            return batches[0]
+
+        def _cat(attr):
+            vals = [getattr(b, attr) for b in batches]
+            if all(v is None for v in vals):
+                return None
+            if any(v is None for v in vals):
+                raise ValueError(f"mixed None/{attr} across concatenated batches")
+            return np.concatenate(vals, axis=0)
+
+        return DataSet(
+            np.concatenate([b.features for b in batches], axis=0),
+            np.concatenate([b.labels for b in batches], axis=0),
+            _cat("features_mask"),
+            _cat("labels_mask"),
+        )
+
     def split_batches(self, batch_size: int) -> List["DataSet"]:
         out = []
         n = self.num_examples()

@@ -104,6 +104,37 @@ class MultipleEpochsIterator(DataSetIterator):
         return self.base.batch_size()
 
 
+class StackedDataSetIterator(DataSetIterator):
+    """Concatenate k consecutive minibatches into one global batch — how a
+    data-parallel trainer turns per-worker batches into one sharded batch
+    (reference: ParallelWrapper round-robin dispatch of one minibatch per
+    DefaultTrainer, ParallelWrapper.java:389-404)."""
+
+    def __init__(self, base: DataSetIterator, k: int):
+        self.base = base
+        self.k = max(1, int(k))
+
+    def __iter__(self):
+        pending: List[DataSet] = []
+        for ds in self.base:
+            pending.append(ds)
+            if len(pending) == self.k:
+                yield DataSet.concat(pending)
+                pending = []
+        if pending:
+            yield DataSet.concat(pending)
+
+    def reset(self):
+        self.base.reset()
+
+    def batch_size(self):
+        b = self.base.batch_size()
+        return None if b is None else b * self.k
+
+    def total_examples(self):
+        return self.base.total_examples()
+
+
 _SENTINEL = object()
 
 

@@ -115,6 +115,9 @@ class MultiLayerNetwork:
         self._output_fn = None
         self._score = None  # last minibatch score (device array, lazy read)
         self._last_etl_ms = 0.0
+        # hook applied to each DataSet before the step — installed by
+        # parallel.ParallelWrapper to shard the batch across the mesh
+        self._batch_transform = None
 
     # -- init ----------------------------------------------------------------
 
@@ -337,6 +340,8 @@ class MultiLayerNetwork:
         return ListDataSetIterator(DataSet(x, y), batch_size)
 
     def _fit_dataset(self, ds: DataSet):
+        if self._batch_transform is not None:
+            ds = self._batch_transform(ds)
         tbptt = (
             self.conf.backprop_type == BackpropType.TRUNCATED_BPTT
             and ds.features.ndim == 3

@@ -1,1 +1,35 @@
-"""Subpackage."""
+"""Scale-out: data parallelism, sharded inference, mesh utilities.
+
+TPU-native replacement for deeplearning4j-scaleout (SURVEY.md §2.4): the
+reference's three data-parallel transports (thread-replica ParallelWrapper,
+Aeron parameter server, Spark parameter averaging) collapse into one
+mechanism here — sharded global batches + XLA GSPMD gradient allreduce over
+ICI/DCN on a `jax.sharding.Mesh`.
+"""
+
+from deeplearning4j_tpu.parallel.mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    batch_sharded,
+    data_parallel_mesh,
+    data_shards,
+    mesh_2d,
+    n_devices,
+    replicated,
+)
+from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
+from deeplearning4j_tpu.parallel.inference import InferenceMode, ParallelInference
+
+__all__ = [
+    "DATA_AXIS",
+    "MODEL_AXIS",
+    "batch_sharded",
+    "data_parallel_mesh",
+    "data_shards",
+    "mesh_2d",
+    "n_devices",
+    "replicated",
+    "ParallelWrapper",
+    "ParallelInference",
+    "InferenceMode",
+]

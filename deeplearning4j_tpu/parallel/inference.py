@@ -1,0 +1,138 @@
+"""ParallelInference — multi-request serving over the device mesh.
+
+Reference: deeplearning4j-scaleout/.../parallelism/ParallelInference.java
+(:33-126) — a pool of model replicas fed from a queue, with
+InferenceMode.SEQUENTIAL (one request per replica call) vs BATCHED (dynamic
+batching via BatchedInferenceObservable, inference/observers/).
+
+TPU-native design: one set of replicated parameters on the mesh; the
+"replica pool" is replaced by batch sharding — a dynamically-batched
+request group is sharded across the data axis and executed once. Dynamic
+batching (the BATCHED mode) is the part that carries over unchanged: a
+collector thread drains the request queue, concatenates up to
+`max_batch_size` examples, runs the jitted forward, and scatters results
+back to the waiting callers.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from concurrent.futures import Future
+from typing import Optional
+
+import jax
+import numpy as np
+
+from deeplearning4j_tpu.parallel.mesh import (
+    batch_sharded,
+    data_parallel_mesh,
+    data_shards,
+    replicated,
+)
+
+
+class InferenceMode:
+    SEQUENTIAL = "sequential"
+    BATCHED = "batched"
+
+
+class ParallelInference:
+    def __init__(
+        self,
+        model,
+        mesh=None,
+        inference_mode: str = InferenceMode.BATCHED,
+        max_batch_size: int = 64,
+        batch_timeout_ms: float = 2.0,
+    ):
+        self.model = model
+        self.mesh = mesh if mesh is not None else data_parallel_mesh()
+        self.mode = inference_mode
+        self.max_batch_size = int(max_batch_size)
+        self.batch_timeout = batch_timeout_ms / 1e3
+        self.n_shards = data_shards(self.mesh)
+        model._require_init()
+        rep = replicated(self.mesh)
+        model.params_list = jax.tree_util.tree_map(
+            lambda a: jax.device_put(a, rep), model.params_list
+        )
+        self._q: "queue.Queue" = queue.Queue()
+        self._shutdown = False
+        self._worker: Optional[threading.Thread] = None
+        if self.mode == InferenceMode.BATCHED:
+            self._worker = threading.Thread(target=self._collector, daemon=True)
+            self._worker.start()
+
+    # -- public --------------------------------------------------------------
+
+    def output(self, x):
+        """Thread-safe inference. In BATCHED mode the call may be fused
+        with concurrent callers' batches (reference:
+        BatchedInferenceObservable)."""
+        if self._shutdown:
+            raise RuntimeError("ParallelInference has been shut down")
+        xx = np.asarray(x)
+        if self.mode == InferenceMode.SEQUENTIAL:
+            return self._run(xx)
+        fut: Future = Future()
+        self._q.put((xx, fut))
+        return fut.result()
+
+    def shutdown(self):
+        self._shutdown = True
+        if self._worker is not None:
+            self._q.put(None)
+            self._worker.join(timeout=5)
+            # requests that raced the sentinel would otherwise hang their
+            # callers forever — fail them explicitly
+            while True:
+                try:
+                    item = self._q.get_nowait()
+                except queue.Empty:
+                    break
+                if item is not None and not item[1].done():
+                    item[1].set_exception(
+                        RuntimeError("ParallelInference shut down")
+                    )
+
+    # -- internals -----------------------------------------------------------
+
+    def _run(self, xx: np.ndarray):
+        sh = (
+            batch_sharded(self.mesh)
+            if xx.shape[0] % self.n_shards == 0
+            else replicated(self.mesh)
+        )
+        return self.model.output(jax.device_put(xx, sh))
+
+    def _collector(self):
+        while not self._shutdown:
+            item = self._q.get()
+            if item is None:
+                return
+            group = [item]
+            count = item[0].shape[0]
+            # drain more requests until the batch limit or a short timeout
+            while count < self.max_batch_size:
+                try:
+                    nxt = self._q.get(timeout=self.batch_timeout)
+                except queue.Empty:
+                    break
+                if nxt is None:
+                    self._shutdown = True
+                    break
+                group.append(nxt)
+                count += nxt[0].shape[0]
+            try:
+                batch = np.concatenate([g[0] for g in group], axis=0)
+                out = np.asarray(self._run(batch))
+                off = 0
+                for xx, fut in group:
+                    n = xx.shape[0]
+                    fut.set_result(out[off : off + n])
+                    off += n
+            except BaseException as e:  # propagate to all waiting callers
+                for _, fut in group:
+                    if not fut.done():
+                        fut.set_exception(e)
