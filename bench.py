@@ -1,61 +1,202 @@
-"""Benchmark entry point — prints ONE JSON line with the headline metric.
+"""Benchmark entry point — prints ONE JSON line.
 
-Current flagship: LeNet-MNIST training throughput (images/sec/chip) on the
-default backend (TPU under axon; CPU elsewhere). Will switch to ResNet-50
-images/sec/chip (BASELINE.md metric of record) once the ComputationGraph
-workload lands. The reference publishes no numbers (BASELINE.json
-published={}), so vs_baseline is reported as 1.0 by convention.
+Headline: ResNet-50 training images/sec/chip (BASELINE.md metric of
+record) with an analytic-MFU estimate; the `workloads` field carries the
+full table (LeNet-MNIST images/sec, GravesLSTM char-rnn tokens/sec), each
+with its own MFU.
 
-Protocol (BASELINE.md): synthetic data (BenchmarkDataSetIterator-equivalent)
-to remove ETL noise; steady-state steps timed after warmup/compile;
-per-chip batch; bf16 compute policy on TPU.
+Protocol (BASELINE.md): synthetic data (BenchmarkDataSetIterator
+equivalent) to exclude ETL; public fit() API drives every workload;
+steady-state steps timed after a warmup fit that includes compilation;
+bf16 compute policy on TPU, f32 on CPU. The reference publishes no numbers
+(BASELINE.json published={}), so vs_baseline is null — an honest "no
+published baseline", not a self-graded 1.0.
 """
 
 import json
+import os
 import time
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.data.iterators import ExistingDataSetIterator
+from deeplearning4j_tpu.utils.flops import (
+    graph_forward_flops,
+    mln_forward_flops,
+    peak_flops_per_chip,
+    train_step_flops,
+)
 
-def bench_lenet(batch: int = 512, steps: int = 30, warmup: int = 5) -> dict:
-    from deeplearning4j_tpu.models.lenet import lenet_network
+
+def _onehot(rng, n, k):
+    y = np.zeros((n, k), np.float32)
+    y[np.arange(n), rng.integers(0, k, n)] = 1.0
+    return y
+
+
+def _device_dataset(x, y) -> DataSet:
+    """Pre-stage the synthetic batch in HBM — the benchmark protocol
+    excludes ETL (BenchmarkDataSetIterator equivalent), and re-uploading
+    the same batch every step would measure the host link, not the chip."""
+    import jax
+
+    return DataSet(jax.device_put(x), jax.device_put(y))
+
+
+def _sync(net):
+    """Force completion. block_until_ready does not actually block through
+    the axon tunnel, so synchronize with a host readback of the last
+    step's score (a scalar — negligible transfer)."""
+    if net._score is not None:
+        float(np.asarray(net._score))
+    else:
+        jax.block_until_ready(net.params_list)
+
+
+def _time_fit(net, make_iter, steps):
+    """Latency-cancelling timing: warmup (compile), then time fits of N and
+    2N steps and report t(2N) - t(N) — the constant dispatch/readback
+    overhead of the device tunnel cancels out."""
+
+    def timed(k):
+        it = make_iter(k)
+        before = net.iteration
+        t0 = time.perf_counter()
+        net.fit(it, epochs=1, async_prefetch=True)
+        _sync(net)
+        dt = time.perf_counter() - t0
+        return dt, net.iteration - before
+
+    timed(2)  # warmup/compile
+    t1, n1 = timed(steps)
+    t2, n2 = timed(2 * steps)
+    assert n2 == 2 * n1, (n1, n2)
+    return max(t2 - t1, 1e-9), n1
+
+
+def bench_resnet50(batch=64, steps=8, image_size=224, classes=1000):
+    from deeplearning4j_tpu.models.resnet import resnet50_conf
+    from deeplearning4j_tpu.nn.compgraph import ComputationGraph
 
     on_tpu = jax.default_backend() not in ("cpu",)
-    net = lenet_network(precision="bf16" if on_tpu else "f32")
-
+    if not on_tpu:  # CPU smoke config — full ResNet-50 on CPU is pointless
+        batch, steps, image_size, classes = 8, 4, 64, 10
+    conf = resnet50_conf(num_classes=classes, image_size=image_size,
+                         precision="bf16" if on_tpu else "f32")
+    net = ComputationGraph(conf).init()
     rng = np.random.default_rng(0)
-    x = rng.random((batch, 784), np.float32)
-    y = np.zeros((batch, 10), np.float32)
-    y[np.arange(batch), rng.integers(0, 10, batch)] = 1.0
-
-    # warmup (includes compile)
-    for _ in range(warmup):
-        states, score = net._fit_step(x, y, None, None)
-        net.state_list = states
-    jax.block_until_ready(net.params_list)
-
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        states, score = net._fit_step(x, y, None, None)
-        net.state_list = states
-    jax.block_until_ready(net.params_list)
-    dt = time.perf_counter() - t0
-
-    ips = batch * steps / dt
+    x = rng.random((batch, image_size, image_size, 3), np.float32)
+    ds = _device_dataset(x, _onehot(rng, batch, classes))
+    dt, n_steps = _time_fit(net, lambda k: ExistingDataSetIterator([ds] * k), steps)
+    ips = batch * n_steps / dt
+    fwd = graph_forward_flops(conf)
+    step_flops = train_step_flops(fwd, batch)
+    mfu = (step_flops * n_steps / dt) / peak_flops_per_chip() if on_tpu else None
     return {
-        "metric": "lenet_mnist_train_images_per_sec_per_chip",
-        "value": round(ips, 1),
+        "value": round(ips, 2),
         "unit": "images/sec/chip",
-        "vs_baseline": 1.0,
-        "backend": jax.default_backend(),
         "batch": batch,
         "steps": steps,
+        "image_size": image_size,
         "seconds": round(dt, 3),
+        "model_flops_per_step": step_flops,
+        "mfu": None if mfu is None else round(mfu, 4),
     }
 
 
-if __name__ == "__main__":
-    result = bench_lenet()
+def bench_lenet(batch=512, steps=30):
+    from deeplearning4j_tpu.models.lenet import lenet_conf
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    on_tpu = jax.default_backend() not in ("cpu",)
+    conf = lenet_conf(precision="bf16" if on_tpu else "f32")
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(0)
+    ds = _device_dataset(rng.random((batch, 784), np.float32),
+                         _onehot(rng, batch, 10))
+    dt, n_steps = _time_fit(net, lambda k: ExistingDataSetIterator([ds] * k), steps)
+    ips = batch * n_steps / dt
+    fwd = mln_forward_flops(conf)
+    step_flops = train_step_flops(fwd, batch)
+    mfu = (step_flops * n_steps / dt) / peak_flops_per_chip() if on_tpu else None
+    return {
+        "value": round(ips, 1),
+        "unit": "images/sec/chip",
+        "batch": batch,
+        "steps": steps,
+        "seconds": round(dt, 3),
+        "model_flops_per_step": step_flops,
+        "mfu": None if mfu is None else round(mfu, 4),
+    }
+
+
+def bench_char_lstm(batch=64, seq_len=200, tbptt=50, vocab=77, hidden=200,
+                    steps=6):
+    """tokens/sec through the TBPTT fit path (each fit batch = seq_len/tbptt
+    optimizer steps)."""
+    from deeplearning4j_tpu.models.charlstm import char_lstm_conf
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    on_tpu = jax.default_backend() not in ("cpu",)
+    if not on_tpu:
+        batch, seq_len, steps, hidden = 16, 100, 3, 64
+    conf = char_lstm_conf(vocab_size=vocab, hidden=hidden, tbptt_length=tbptt,
+                          precision="bf16" if on_tpu else "f32")
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(0)
+    idx = rng.integers(0, vocab, (batch, seq_len))
+    x = np.eye(vocab, dtype=np.float32)[idx]
+    yidx = rng.integers(0, vocab, (batch, seq_len))
+    y = np.eye(vocab, dtype=np.float32)[yidx]
+    ds = _device_dataset(x, y)
+    segments = -(-seq_len // tbptt)
+    dt, n_steps = _time_fit(net, lambda k: ExistingDataSetIterator([ds] * k), steps)
+    fit_batches = n_steps / segments
+    tokens = batch * seq_len * fit_batches / dt
+    fwd = mln_forward_flops(conf)  # per example, per timestep (no ts set)
+    tf = train_step_flops(fwd * seq_len, batch) * fit_batches / dt
+    mfu = tf / peak_flops_per_chip() if on_tpu else None
+    return {
+        "value": round(tokens, 1),
+        "unit": "tokens/sec/chip",
+        "batch": batch,
+        "seq_len": seq_len,
+        "tbptt": tbptt,
+        "hidden": hidden,
+        "seconds": round(dt, 3),
+        "mfu": None if mfu is None else round(mfu, 4),
+    }
+
+
+def main():
+    workloads = {}
+    errors = {}
+    for name, fn in (
+        ("resnet50", bench_resnet50),
+        ("lenet", bench_lenet),
+        ("char_lstm", bench_char_lstm),
+    ):
+        try:
+            workloads[name] = fn()
+        except Exception as e:  # keep the headline line printable
+            errors[name] = f"{type(e).__name__}: {e}"
+    head = workloads.get("resnet50", {})
+    result = {
+        "metric": "resnet50_train_images_per_sec_per_chip",
+        "value": head.get("value"),
+        "unit": head.get("unit", "images/sec/chip"),
+        "vs_baseline": None,  # reference publishes no numbers (BASELINE.md)
+        "mfu": head.get("mfu"),
+        "backend": jax.default_backend(),
+        "device": jax.devices()[0].device_kind,
+        "workloads": workloads,
+    }
+    if errors:
+        result["errors"] = errors
     print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -104,6 +104,24 @@ class MultipleEpochsIterator(DataSetIterator):
         return self.base.batch_size()
 
 
+class MultiDataSetIterator:
+    """SPI: iterable over MultiDataSet minibatches with reset()
+    (reference: nd4j MultiDataSetIterator, consumed by
+    ComputationGraph.fit)."""
+
+    def __iter__(self):
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        pass
+
+    def batch_size(self) -> Optional[int]:
+        return None
+
+    def total_examples(self) -> Optional[int]:
+        return None
+
+
 class StackedDataSetIterator(DataSetIterator):
     """Concatenate k consecutive minibatches into one global batch — how a
     data-parallel trainer turns per-worker batches into one sharded batch

@@ -3,3 +3,9 @@
 """
 
 from deeplearning4j_tpu.models.lenet import lenet_conf, lenet_network
+from deeplearning4j_tpu.models.resnet import (
+    resnet_conf,
+    resnet50_conf,
+    resnet50_network,
+    tiny_resnet_conf,
+)

@@ -1,0 +1,50 @@
+"""GravesLSTM character-level RNN — the BASELINE.md "char-rnn tokens/sec"
+workload (reference: dl4j-examples GravesLSTMCharModellingExample — two
+GravesLSTM layers + RnnOutputLayer(MCXENT), TBPTT; LSTM kernel
+nn/layers/recurrent/LSTMHelpers.java:62,291)."""
+
+from __future__ import annotations
+
+from deeplearning4j_tpu.nn.conf import (
+    BackpropType,
+    GravesLSTM,
+    InputType,
+    NeuralNetConfiguration,
+    RnnOutputLayer,
+    Updater,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+def char_lstm_conf(vocab_size: int = 77, hidden: int = 200, layers: int = 2,
+                   tbptt_length: int = 50, seed: int = 12345,
+                   learning_rate: float = 0.1, precision: str = "f32"):
+    b = (
+        NeuralNetConfiguration.builder()
+        .seed(seed)
+        .updater(Updater.RMSPROP)
+        .rms_decay(0.95)
+        .learning_rate(learning_rate)
+        .weight_init("xavier")
+        .precision(precision)
+        .list()
+    )
+    for _ in range(layers):
+        b = b.layer(GravesLSTM(n_out=hidden, activation="tanh"))
+    return (
+        b.layer(RnnOutputLayer(n_out=vocab_size, activation="softmax",
+                               loss="mcxent"))
+        .backprop_type(BackpropType.TRUNCATED_BPTT)
+        .t_bptt_lengths(tbptt_length)
+        .set_input_type(InputType.recurrent(vocab_size))
+        .build()
+    )
+
+
+def char_lstm_network(vocab_size: int = 77, hidden: int = 200, layers: int = 2,
+                      tbptt_length: int = 50, precision: str = "f32",
+                      **kw) -> MultiLayerNetwork:
+    return MultiLayerNetwork(
+        char_lstm_conf(vocab_size, hidden, layers, tbptt_length,
+                       precision=precision, **kw)
+    ).init()

@@ -1,0 +1,452 @@
+"""ComputationGraph — the DAG network.
+
+Analog of the reference's nn/graph/ComputationGraph.java (3,062 LoC).
+TPU-first translation of its design decisions:
+
+- reference: topo order computed once (:340,1055), forward = walk topo
+  order calling Vertex.doForward (:1291-1292), backward = reverse walk with
+  explicit epsilon accumulation at fan-out vertices (:1480-1502).
+- here: the same cached topo order drives a *pure function* of
+  (params, inputs) built once and jitted; backward is jax.grad of that
+  function, so fan-out accumulation is handled by autodiff and the whole
+  step (forward + backward + updater) compiles to one XLA program.
+
+Parameters are a list of per-layer-vertex dicts in topological order —
+the same flattening convention as MultiLayerNetwork, so params()/
+set_params() and the serializer work identically.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.common.dtypes import policy_from_name
+from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet
+from deeplearning4j_tpu.data.iterators import (
+    DataSetIterator,
+    ListDataSetIterator,
+    MultiDataSetIterator,
+)
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.nn.conf.graph import (
+    ComputationGraphConfiguration,
+    LayerVertex,
+)
+from deeplearning4j_tpu.nn.layers.registry import (
+    LayerContext,
+    forward_layer,
+    init_layer_params,
+    init_layer_state,
+)
+from deeplearning4j_tpu.nn.multilayer import (
+    _OUTPUT_LAYER_TYPES,
+    _is_recurrent,
+    _preout_of_output_layer,
+    _regularizable,
+)
+from deeplearning4j_tpu.nn.netbase import NetworkBase
+from deeplearning4j_tpu.ops.losses import loss_value
+from deeplearning4j_tpu.train.evaluation import Evaluation
+from deeplearning4j_tpu.train.updaters import (
+    normalize_gradients,
+    schedule_lr,
+    updater_from_conf,
+)
+
+
+def _as_multidataset(ds) -> MultiDataSet:
+    if isinstance(ds, MultiDataSet):
+        return ds
+    if isinstance(ds, DataSet):
+        return MultiDataSet(
+            [ds.features], [ds.labels],
+            None if ds.features_mask is None else [ds.features_mask],
+            None if ds.labels_mask is None else [ds.labels_mask],
+        )
+    raise TypeError(f"expected DataSet or MultiDataSet, got {type(ds)}")
+
+
+class ComputationGraph(NetworkBase):
+    """DAG network. API mirrors the reference: init, fit, output, score,
+    evaluate, params/set_params."""
+
+    def __init__(self, conf: ComputationGraphConfiguration):
+        super().__init__()
+        self.conf = conf
+        self.net_conf = conf.net_conf
+        self.policy = policy_from_name(self.net_conf.precision)
+        self.updater_def = updater_from_conf(self.net_conf)
+        self.topo: List[str] = conf.topological_order()
+        self.layer_vertex_names: List[str] = [
+            n for n in self.topo if isinstance(conf.vertices.get(n), LayerVertex)
+        ]
+        self._pidx: Dict[str, int] = {
+            n: i for i, n in enumerate(self.layer_vertex_names)
+        }
+        self._layer_confs: List[L.LayerConf] = [
+            conf.vertices[n].layer for n in self.layer_vertex_names
+        ]
+        self._train_step_fn = None
+        self._output_fn = None
+
+    def _ordered_layer_confs(self):
+        return self._layer_confs
+
+    # -- init ----------------------------------------------------------------
+
+    def init(self) -> "ComputationGraph":
+        key = jax.random.PRNGKey(self.net_conf.seed)
+        dtype = self.policy.param_dtype
+        self.params_list = []
+        self.state_list = []
+        for i, lc in enumerate(self._layer_confs):
+            self.params_list.append(
+                init_layer_params(jax.random.fold_in(key, i), lc, dtype)
+            )
+            self.state_list.append(init_layer_state(lc, dtype))
+        self.upd_state = self.updater_def.init_tree(self.params_list)
+        return self
+
+    # -- forward -------------------------------------------------------------
+
+    def _forward(self, params, states, inputs: Sequence, *, training, rng,
+                 input_masks: Optional[Sequence] = None, preout_outputs=False):
+        """Pure forward over the cached topo order. Returns
+        (activations dict, new_states list)."""
+        conf = self.conf
+        acts: Dict[str, jnp.ndarray] = dict(zip(conf.inputs, inputs))
+        masks: Dict[str, jnp.ndarray] = {}
+        if input_masks is not None:
+            masks = {
+                n: m for n, m in zip(conf.inputs, input_masks) if m is not None
+            }
+        # single-mask convenience: an rnn layer deeper in the graph uses the
+        # sole input mask (the multi-input per-branch case needs explicit
+        # LastTimeStep/mask vertices, as in the reference)
+        sole_mask = next(iter(masks.values())) if len(masks) == 1 else None
+        new_states: List[Optional[dict]] = [None] * len(self.layer_vertex_names)
+        env = {"activations": acts, "input_masks": masks}
+        for name in self.topo:
+            if name in acts:
+                continue
+            v = conf.vertices[name]
+            xs = [acts[i] for i in conf.vertex_inputs[name]]
+            if isinstance(v, LayerVertex):
+                x = xs[0]
+                timesteps = x.shape[1] if x.ndim == 3 else None
+                if v.preprocessor is not None:
+                    x = v.preprocessor(x, {"timesteps": timesteps})
+                    if hasattr(x, "ndim") and x.ndim == 3:
+                        timesteps = x.shape[1]
+                pidx = self._pidx[name]
+                lc = v.layer
+                ctx = LayerContext(
+                    training=training,
+                    rng=jax.random.fold_in(rng, pidx) if rng is not None else None,
+                    mask=sole_mask if (hasattr(x, "ndim") and x.ndim == 3) else None,
+                    timesteps=timesteps,
+                    state=states[pidx],
+                )
+                if (
+                    preout_outputs
+                    and name in conf.outputs
+                    and isinstance(lc, _OUTPUT_LAYER_TYPES)
+                ):
+                    from deeplearning4j_tpu.nn.layers.core import apply_dropout
+
+                    x = apply_dropout(x, lc.dropout, ctx)
+                    x = _preout_of_output_layer(lc, params[pidx], x)
+                    ns = None
+                else:
+                    x, ns = forward_layer(lc, params[pidx], x, ctx)
+                new_states[pidx] = ns
+                acts[name] = x
+            else:
+                acts[name] = v.forward(xs, env)
+        return acts, new_states
+
+    def _merge_states(self, old, new):
+        return [n if n is not None else o for o, n in zip(old, new)]
+
+    # -- loss ----------------------------------------------------------------
+
+    def _loss(self, params, states, xs, ys, f_masks, l_masks, rng, training=True):
+        conf = self.conf
+        xs = [self.policy.cast_input(x) for x in xs]
+        acts, new_states = self._forward(
+            params, states, xs, training=training, rng=rng,
+            input_masks=f_masks, preout_outputs=True,
+        )
+        score = 0.0
+        n_heads = 0
+        for i, name in enumerate(conf.outputs):
+            v = conf.vertices[name]
+            if not (isinstance(v, LayerVertex)
+                    and isinstance(v.layer, _OUTPUT_LAYER_TYPES)):
+                continue
+            lc = v.layer
+            if isinstance(lc, L.CenterLossOutputLayer):
+                raise NotImplementedError(
+                    "CenterLossOutputLayer in a ComputationGraph is not "
+                    "wired yet; use MultiLayerNetwork (which implements the "
+                    "center term + EMA center updates)"
+                )
+            lm = l_masks[i] if l_masks is not None else None
+            per_ex = loss_value(
+                lc.loss, ys[i], self.policy.cast_output(acts[name]),
+                lc.activation, lm,
+            )
+            score = score + jnp.mean(per_ex)
+            n_heads += 1
+        if n_heads == 0:
+            raise ValueError(
+                "no output vertex is a loss head (OutputLayer/RnnOutputLayer/"
+                "LossLayer) — cannot compute a training loss"
+            )
+        reg = 0.0
+        for lc, p in zip(self._layer_confs, params):
+            inner = lc.inner if isinstance(lc, L.FrozenLayer) else lc
+            l1 = getattr(inner, "l1", 0.0) or 0.0
+            l2 = getattr(inner, "l2", 0.0) or 0.0
+            if l1 == 0.0 and l2 == 0.0:
+                continue
+            for pname, w in p.items():
+                if _regularizable(pname):
+                    if l1:
+                        reg = reg + l1 * jnp.sum(jnp.abs(w))
+                    if l2:
+                        reg = reg + 0.5 * l2 * jnp.sum(w * w)
+        return score + reg, new_states
+
+    # -- train step ----------------------------------------------------------
+
+    def _lr_mult_tree(self):
+        base = self.net_conf.learning_rate
+        out = []
+        for lc, p in zip(self._layer_confs, self.params_list):
+            inner = lc.inner if isinstance(lc, L.FrozenLayer) else lc
+            layer_lr = getattr(inner, "learning_rate", None)
+            bias_lr = getattr(inner, "bias_learning_rate", None)
+            mult = {}
+            for name in p:
+                if name == "b" and bias_lr is not None:
+                    mult[name] = bias_lr / base
+                elif layer_lr is not None:
+                    mult[name] = layer_lr / base
+                else:
+                    mult[name] = 1.0
+            out.append(mult)
+        return out
+
+    def _trainable_mask(self):
+        return [
+            {k: (0.0 if isinstance(lc, L.FrozenLayer) else 1.0) for k in p}
+            for lc, p in zip(self._layer_confs, self.params_list)
+        ]
+
+    def _build_train_step(self):
+        gnorm = self.net_conf.gradient_normalization
+        gthresh = self.net_conf.gradient_normalization_threshold
+        mults = self._lr_mult_tree()
+        tmask = self._trainable_mask()
+        updater = self.updater_def
+        minimize = self.net_conf.minimize
+
+        def step(params, states, upd_state, xs, ys, f_masks, l_masks, lr, t, rng):
+            def loss_fn(p):
+                return self._loss(p, states, xs, ys, f_masks, l_masks, rng)
+
+            (score, new_states), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(params)
+            if not minimize:
+                grads = jax.tree_util.tree_map(lambda g: -g, grads)
+            grads = [
+                {k: g[k] * m[k] for k in g} for g, m in zip(grads, tmask)
+            ]
+            grads = normalize_gradients(grads, gnorm, gthresh)
+            lr_tree = [
+                {k: lr * m[k] for k in g} for g, m in zip(grads, mults)
+            ]
+            updates, new_upd = updater.apply_tree(grads, upd_state, lr_tree, t)
+            new_params = jax.tree_util.tree_map(jnp.add, params, updates)
+            merged = self._merge_states(states, new_states)
+            return new_params, merged, new_upd, score
+
+        backend = jax.default_backend()
+        donate = (0, 2) if backend != "cpu" else ()
+        return jax.jit(step, donate_argnums=donate)
+
+    def _fit_step(self, xs, ys, f_masks, l_masks, stateful_states=None):
+        if self._train_step_fn is None:
+            self._train_step_fn = self._build_train_step()
+        lr = schedule_lr(self.net_conf, self.iteration)
+        rng = jax.random.fold_in(
+            jax.random.PRNGKey(self.net_conf.seed ^ 0x5EED), self.iteration
+        )
+        states = stateful_states if stateful_states is not None else self.state_list
+        jas = lambda t: None if t is None else [
+            None if a is None else jnp.asarray(a) for a in t
+        ]
+        params, states, upd, score = self._train_step_fn(
+            self.params_list, states, self.upd_state,
+            [jnp.asarray(x) for x in xs], [jnp.asarray(y) for y in ys],
+            jas(f_masks), jas(l_masks),
+            jnp.asarray(lr, jnp.float32), jnp.asarray(float(self.iteration)),
+            rng,
+        )
+        self.params_list = params
+        self.upd_state = upd
+        self._score = score
+        self.iteration += 1
+        return states, score
+
+    # -- fit -----------------------------------------------------------------
+
+    def fit(self, data, labels=None, *, epochs: int = 1, batch_size: int = 32,
+            async_prefetch: bool = True):
+        """Train. Accepts (features, labels) arrays, a DataSet/MultiDataSet,
+        or a DataSetIterator/MultiDataSetIterator (reference:
+        ComputationGraph.fit overloads :857-867)."""
+        self._require_init()
+        if isinstance(data, (DataSetIterator, MultiDataSetIterator)):
+            iterator = data
+        elif isinstance(data, MultiDataSet):
+            iterator = _ListMultiIterator(data, batch_size)
+        elif isinstance(data, DataSet):
+            iterator = ListDataSetIterator(data, batch_size)
+        else:
+            iterator = ListDataSetIterator(
+                DataSet(np.asarray(data), np.asarray(labels)), batch_size
+            )
+        return self._run_fit(iterator, epochs, async_prefetch)
+
+    def _fit_dataset(self, ds):
+        if self.conf.backprop_type == "tbptt":
+            raise NotImplementedError(
+                "TBPTT for ComputationGraph is not implemented yet; use "
+                "BackpropType.STANDARD or a MultiLayerNetwork"
+            )
+        mds = _as_multidataset(ds)
+        states, _ = self._fit_step(
+            mds.features, mds.labels, mds.features_masks, mds.labels_masks
+        )
+        self.state_list = states
+        self._notify(mds.num_examples())
+
+    # -- inference -----------------------------------------------------------
+
+    def output(self, *inputs):
+        """Forward pass; returns one array for a single-output graph, else
+        a list in set_outputs order (reference: ComputationGraph.output)."""
+        self._require_init()
+        if self._output_fn is None:
+            def fwd(params, states, xs):
+                xs = [self.policy.cast_input(x) for x in xs]
+                acts, _ = self._forward(
+                    params, states, xs, training=False, rng=None
+                )
+                return [
+                    self.policy.cast_output(acts[n]) for n in self.conf.outputs
+                ]
+
+            self._output_fn = jax.jit(fwd)
+        outs = self._output_fn(
+            self.params_list, self.state_list,
+            [jnp.asarray(x) for x in inputs],
+        )
+        return outs[0] if len(outs) == 1 else outs
+
+    def feed_forward(self, *inputs):
+        """All vertex activations as a dict — debugging/inspection path."""
+        self._require_init()
+        acts, _ = self._forward(
+            self.params_list, self.state_list,
+            [jnp.asarray(x) for x in inputs], training=False, rng=None,
+        )
+        return acts
+
+    def score(self, data, labels=None) -> float:
+        self._require_init()
+        if isinstance(data, (DataSet, MultiDataSet)):
+            mds = _as_multidataset(data)
+        else:
+            mds = _as_multidataset(DataSet(np.asarray(data), np.asarray(labels)))
+        s, _ = self._loss(
+            self.params_list, self.state_list,
+            [jnp.asarray(x) for x in mds.features],
+            [jnp.asarray(y) for y in mds.labels],
+            None if mds.features_masks is None else [
+                None if m is None else jnp.asarray(m) for m in mds.features_masks
+            ],
+            None if mds.labels_masks is None else [
+                None if m is None else jnp.asarray(m) for m in mds.labels_masks
+            ],
+            rng=None, training=False,
+        )
+        return float(s)
+
+    def evaluate(self, data, labels=None, batch_size: int = 256) -> Evaluation:
+        """Classification evaluation for single-input single-output graphs."""
+        ev = Evaluation()
+        if isinstance(data, (DataSetIterator, MultiDataSetIterator)):
+            batches = data
+        elif isinstance(data, (DataSet, MultiDataSet)):
+            batches = [data]
+        else:
+            batches = DataSet(np.asarray(data), np.asarray(labels)).split_batches(batch_size)
+        for b in batches:
+            mds = _as_multidataset(b)
+            out = self.output(*mds.features)
+            lm = None if mds.labels_masks is None else mds.labels_masks[0]
+            ev.eval_batch(mds.labels[0], out, lm)
+        return ev
+
+    def clone(self) -> "ComputationGraph":
+        import copy
+
+        other = ComputationGraph(copy.deepcopy(self.conf))
+        if self.params_list is not None:
+            other.init()
+            other.params_list = jax.tree_util.tree_map(
+                lambda a: a, self.params_list
+            )
+            other.state_list = [
+                None if s is None else dict(s) for s in self.state_list
+            ]
+        return other
+
+
+class _ListMultiIterator(MultiDataSetIterator):
+    """Minibatches from one in-memory MultiDataSet."""
+
+    def __init__(self, mds: MultiDataSet, batch: int):
+        self.mds = mds
+        self.batch = batch
+
+    def __iter__(self):
+        n = self.mds.num_examples()
+        for i in range(0, n, self.batch):
+            sl = slice(i, min(i + self.batch, n))
+
+            def cut(arrs):
+                return None if arrs is None else [
+                    None if a is None else a[sl] for a in arrs
+                ]
+
+            yield MultiDataSet(
+                [f[sl] for f in self.mds.features],
+                [l[sl] for l in self.mds.labels],
+                cut(self.mds.features_masks),
+                cut(self.mds.labels_masks),
+            )
+
+    def batch_size(self):
+        return self.batch
+
+    def total_examples(self):
+        return self.mds.num_examples()

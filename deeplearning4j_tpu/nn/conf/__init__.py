@@ -38,4 +38,22 @@ from deeplearning4j_tpu.nn.conf.network import (
     NeuralNetConfiguration,
     Updater,
 )
+from deeplearning4j_tpu.nn.conf.graph import (
+    ComputationGraphConfiguration,
+    DuplicateToTimeSeriesVertex,
+    ElementWiseVertex,
+    GraphBuilder,
+    L2NormalizeVertex,
+    L2Vertex,
+    LastTimeStepVertex,
+    LayerVertex,
+    MergeVertex,
+    PreprocessorVertex,
+    ReshapeVertex,
+    ScaleVertex,
+    ShiftVertex,
+    StackVertex,
+    SubsetVertex,
+    UnstackVertex,
+)
 from deeplearning4j_tpu.nn.conf.serde import config_from_dict, config_to_dict

@@ -401,6 +401,20 @@ class AutoEncoder(FeedForwardLayerConf):
     loss: str = "mse"
 
 
+@register_config("layer.rbm")
+@dataclasses.dataclass(kw_only=True)
+class RBM(FeedForwardLayerConf):
+    """Restricted Boltzmann machine (reference: nn/conf/layers/RBM.java +
+    nn/layers/feedforward/rbm/RBM.java — CD-k contrastive divergence with
+    HiddenUnit/VisibleUnit types, :102,223-279). Supervised path behaves
+    like a dense layer (propUp); unsupervised pretraining runs CD-k."""
+
+    hidden_unit: str = "binary"  # binary | gaussian | rectified
+    visible_unit: str = "binary"  # binary | gaussian
+    k: int = 1  # CD-k Gibbs steps
+    sparsity: float = 0.0
+
+
 @register_config("layer.vae")
 @dataclasses.dataclass(kw_only=True)
 class VariationalAutoencoder(FeedForwardLayerConf):

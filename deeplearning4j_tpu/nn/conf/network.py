@@ -412,3 +412,10 @@ class Builder:
 
     def list(self) -> ListBuilder:
         return ListBuilder(self.build())
+
+    def graph_builder(self):
+        """DAG configuration builder (reference:
+        NeuralNetConfiguration.Builder.graphBuilder())."""
+        from deeplearning4j_tpu.nn.conf.graph import GraphBuilder
+
+        return GraphBuilder(self.build())

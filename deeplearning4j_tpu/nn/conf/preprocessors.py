@@ -81,10 +81,15 @@ class RnnToFeedForwardPreProcessor(InputPreProcessor):
 @dataclasses.dataclass(kw_only=True)
 class FeedForwardToRnnPreProcessor(InputPreProcessor):
     """[batch*time, size] -> [batch, time, size]; time length comes from the
-    network's current minibatch context (passed via state)."""
+    network's current minibatch context (passed via state). Genuinely
+    feed-forward input (no prior 3-D activation => no time context) is
+    treated as a single timestep, matching the reference
+    (FeedForwardToRnnPreProcessor handles 2-D input as t=1)."""
 
     def __call__(self, x, state=None):
-        ts = state["timesteps"] if state else -1
+        ts = (state or {}).get("timesteps")
+        if ts is None:
+            ts = 1
         return x.reshape(-1, ts, x.shape[-1])
 
     def output_type(self, it):

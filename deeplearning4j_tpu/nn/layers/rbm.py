@@ -1,0 +1,104 @@
+"""Restricted Boltzmann machine.
+
+Reference: nn/layers/feedforward/rbm/RBM.java — contrastiveDivergence()
+(:102) runs CD-k Gibbs chains: propUp (:224), sampleHiddenGivenVisible
+(:223), gibbhVh (:208), propDown (:276), with BINARY/GAUSSIAN/RECTIFIED
+unit-type switches (:228,279).
+
+TPU-first shape: the whole CD-k chain — both matmuls per Gibbs step and the
+Bernoulli sampling — is one jitted computation; the CD statistics
+(positive/negative phase outer products) are returned as a gradient-shaped
+pytree so the standard updater applies them like any other gradient.
+CD is not the gradient of a tractable objective, so this is computed
+explicitly rather than via autodiff (the reference does the same — the
+Gibbs chain is hand-rolled there too).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.nn.layers.core import apply_dropout
+from deeplearning4j_tpu.nn.layers.registry import LayerContext, register_layer
+from deeplearning4j_tpu.nn.weights import init_weights
+from deeplearning4j_tpu.ops.activations import apply_activation
+
+
+def rbm_init(key, conf: L.RBM, dtype):
+    kw, _ = jax.random.split(key)
+    W = init_weights(kw, (conf.n_in, conf.n_out), conf.n_in, conf.n_out,
+                     conf.weight_init, conf.dist, dtype)
+    return {
+        "W": W,
+        "b": jnp.full((conf.n_out,), conf.bias_init or 0.0, dtype),  # hidden
+        "vb": jnp.zeros((conf.n_in,), dtype),  # visible
+    }
+
+
+def rbm_forward(conf: L.RBM, params, x, ctx: LayerContext):
+    """Supervised path = propUp: activation(x W + hidden bias) (reference:
+    RBM.java activate/propUp :224)."""
+    x = apply_dropout(x, conf.dropout, ctx)
+    z = x @ params["W"] + params["b"]
+    return apply_activation(conf.activation, z, key=ctx.rng, training=ctx.training), None
+
+
+def rbm_order(conf):
+    return ("W", "b", "vb")
+
+
+register_layer(L.RBM, rbm_init, rbm_forward, order_fn=rbm_order)
+
+
+def _prop_up(conf, params, v):
+    pre = v @ params["W"] + params["b"]
+    if conf.hidden_unit == "gaussian":
+        return pre
+    if conf.hidden_unit == "rectified":
+        return jax.nn.relu(pre)
+    return jax.nn.sigmoid(pre)
+
+
+def _prop_down(conf, params, h):
+    pre = h @ params["W"].T + params["vb"]
+    if conf.visible_unit == "gaussian":
+        return pre
+    return jax.nn.sigmoid(pre)
+
+
+def _sample_hidden(conf, h_prob, key):
+    if conf.hidden_unit == "binary":
+        return jax.random.bernoulli(key, h_prob).astype(h_prob.dtype)
+    if conf.hidden_unit == "gaussian":
+        return h_prob + jax.random.normal(key, h_prob.shape, h_prob.dtype)
+    return h_prob  # rectified: use the mean (reference uses NReLU sampling)
+
+
+def rbm_cd_stats(conf: L.RBM, params, v0, rng):
+    """One CD-k estimate. Returns (grads pytree matching params, per-example
+    reconstruction cross-entropy as the monitoring score) — gradient sign
+    convention: DESCENT direction for the updater (minimize -logp)."""
+    bsz = v0.shape[0]
+    h0_prob = _prop_up(conf, params, v0)
+    h = _sample_hidden(conf, h0_prob, jax.random.fold_in(rng, 0))
+    vk = v0
+    hk_prob = h0_prob
+    for step in range(int(conf.k)):
+        vk = _prop_down(conf, params, h)
+        hk_prob = _prop_up(conf, params, vk)
+        h = _sample_hidden(conf, hk_prob, jax.random.fold_in(rng, step + 1))
+    inv_b = 1.0 / bsz
+    grads = {
+        "W": -(v0.T @ h0_prob - vk.T @ hk_prob) * inv_b,
+        "b": -jnp.mean(h0_prob - hk_prob, axis=0),
+        "vb": -jnp.mean(v0 - vk, axis=0),
+    }
+    if conf.sparsity:
+        # sparsity penalty pushes mean hidden activation toward the target
+        grads["b"] = grads["b"] + conf.sparsity * jnp.mean(h0_prob, axis=0)
+    eps = 1e-7
+    vr = jnp.clip(_prop_down(conf, params, h0_prob), eps, 1 - eps)
+    recon_xent = -jnp.sum(v0 * jnp.log(vr) + (1 - v0) * jnp.log(1 - vr), axis=-1)
+    return grads, recon_xent

@@ -13,6 +13,13 @@ per-timestep Java loop). TPU-first redesign:
 
 Gate block layout in the fused [*, 4H] matrices: [i | f | g | o]
 (input gate, forget gate, cell candidate, output gate).
+NOTE: the reference's flattened layout is IFOG (input, forget, output,
+modulation — LSTMParamInitializer.java:108) with peepholes packed as extra
+recurrent-weight columns; the flat params()/set_params() view here is
+therefore NOT reference-checkpoint-compatible for recurrent layers. DL4J
+checkpoint import must permute gate blocks at the boundary (the planned
+dl4j-zip reader's job), exactly as the Keras importer transposes conv
+kernels.
 
 Masking (variable-length sequences): at masked steps the carried (h, c)
 pass through unchanged and the emitted output is zero, which reproduces the

@@ -45,12 +45,7 @@ from deeplearning4j_tpu.nn.layers.registry import (
     init_layer_params,
     init_layer_state,
 )
-from deeplearning4j_tpu.nn.params import (
-    flat_to_params,
-    num_params,
-    param_table,
-    params_to_flat,
-)
+from deeplearning4j_tpu.nn.netbase import NetworkBase
 from deeplearning4j_tpu.ops.losses import loss_value
 from deeplearning4j_tpu.train.evaluation import Evaluation, RegressionEvaluation
 from deeplearning4j_tpu.train.updaters import (
@@ -94,30 +89,23 @@ def _preout_of_output_layer(conf, params, x):
     return x @ params["W"] + params["b"]
 
 
-class MultiLayerNetwork:
+class MultiLayerNetwork(NetworkBase):
     """Sequential network. API mirrors the reference: init, fit, output,
     score, evaluate, params/set_params, rnn_time_step."""
 
     def __init__(self, conf: MultiLayerConfiguration):
+        super().__init__()
         self.conf = conf
         self.layer_confs: List[L.LayerConf] = list(conf.layers)
         self.net_conf = conf.net_conf
         self.policy = policy_from_name(self.net_conf.precision)
         self.updater_def = updater_from_conf(self.net_conf)
-        self.listeners = []
-        self.iteration = 0
-        self.epoch = 0
-        self.params_list = None
-        self.state_list = None
-        self.upd_state = None
         self._rnn_states = None  # streaming inference state (rnn_time_step)
         self._train_step_fn = None
         self._output_fn = None
-        self._score = None  # last minibatch score (device array, lazy read)
-        self._last_etl_ms = 0.0
-        # hook applied to each DataSet before the step — installed by
-        # parallel.ParallelWrapper to shard the batch across the mesh
-        self._batch_transform = None
+
+    def _ordered_layer_confs(self):
+        return self.layer_confs
 
     # -- init ----------------------------------------------------------------
 
@@ -132,20 +120,6 @@ class MultiLayerNetwork:
             )
             self.state_list.append(init_layer_state(conf, dtype))
         self.upd_state = self.updater_def.init_tree(self.params_list)
-        return self
-
-    def _require_init(self):
-        if self.params_list is None:
-            self.init()
-
-    # -- listeners -----------------------------------------------------------
-
-    def set_listeners(self, *listeners):
-        self.listeners = list(listeners)
-        return self
-
-    def add_listener(self, listener):
-        self.listeners.append(listener)
         return self
 
     # -- forward -------------------------------------------------------------
@@ -177,6 +151,11 @@ class MultiLayerNetwork:
             )
             is_last = i == len(confs) - 1
             if preout_last and is_last and isinstance(conf, _OUTPUT_LAYER_TYPES):
+                # input dropout applies to the output layer too (reference:
+                # BaseOutputLayer preOutput applies Dropout to its input)
+                from deeplearning4j_tpu.nn.layers.core import apply_dropout
+
+                x = apply_dropout(x, conf.dropout, ctx)
                 x = _preout_of_output_layer(conf, params[i], x)
                 ns = None
             else:
@@ -197,13 +176,18 @@ class MultiLayerNetwork:
                 "LossLayer to compute a training loss"
             )
         x = self.policy.cast_input(x)
-        preout, new_states = self._forward(
-            params, states, x, training=training, rng=rng, f_mask=f_mask,
-            preout_last=True,
-        )
-        preout = self.policy.cast_output(preout)
-        per_ex = loss_value(last.loss, y, preout, last.activation, l_mask)
-        score = jnp.mean(per_ex)
+        if isinstance(last, L.CenterLossOutputLayer):
+            score, new_states = self._center_loss(
+                params, states, x, y, f_mask, l_mask, rng, training
+            )
+        else:
+            preout, new_states = self._forward(
+                params, states, x, training=training, rng=rng, f_mask=f_mask,
+                preout_last=True,
+            )
+            preout = self.policy.cast_output(preout)
+            per_ex = loss_value(last.loss, y, preout, last.activation, l_mask)
+            score = jnp.mean(per_ex)
         # L1/L2 penalties (reference: BaseLayer.calcL1/calcL2 added to score;
         # gradients come from differentiating this same expression)
         reg = 0.0
@@ -220,6 +204,50 @@ class MultiLayerNetwork:
                     if l2:
                         reg = reg + 0.5 * l2 * jnp.sum(w * w)
         return score + reg, new_states
+
+    def _center_loss(self, params, states, x, y, f_mask, l_mask, rng, training):
+        """Center loss (reference: nn/layers/training/CenterLossOutputLayer
+        .java): base loss + lambda/2 * ||f - c_y||^2 on the output layer's
+        input features, with the per-class centers EMA-updated toward the
+        batch class means (alpha) as non-trainable state."""
+        from deeplearning4j_tpu.nn.layers.core import apply_dropout
+
+        last: L.CenterLossOutputLayer = self.layer_confs[-1]
+        n = len(self.layer_confs)
+        feats, new_states = self._forward(
+            params, states, x, training=training, rng=rng, f_mask=f_mask,
+            to_layer=n - 1,
+        )
+        ctx_last = LayerContext(
+            training=training,
+            rng=jax.random.fold_in(rng, n - 1) if rng is not None else None,
+        )
+        feats = apply_dropout(feats, last.dropout, ctx_last)
+        preout = _preout_of_output_layer(last, params[-1], feats)
+        preout = self.policy.cast_output(preout)
+        per_ex = loss_value(last.loss, y, preout, last.activation, l_mask)
+
+        centers = states[-1]["centers"].astype(feats.dtype)  # [classes, nIn]
+        y32 = y.astype(feats.dtype)
+        per_example_center = y32 @ centers  # one-hot pick
+        diff = feats - per_example_center
+        center_per_ex = 0.5 * jnp.sum(diff * diff, axis=-1)
+        score = jnp.mean(per_ex) + last.lambda_ * jnp.mean(center_per_ex)
+
+        if training:
+            # EMA update: c_k <- (1-alpha) c_k + alpha * mean(f_i : y_i = k),
+            # only for classes present in the batch; gradients do not flow
+            # into the centers (they are state, not params)
+            f_sg = jax.lax.stop_gradient(feats)
+            counts = jnp.sum(y32, axis=0)[:, None]  # [classes, 1]
+            sums = y32.T @ f_sg  # [classes, nIn]
+            means = sums / jnp.maximum(counts, 1.0)
+            updated = jnp.where(
+                counts > 0, (1.0 - last.alpha) * centers + last.alpha * means,
+                centers,
+            )
+            new_states[-1] = {"centers": updated.astype(states[-1]["centers"].dtype)}
+        return score, new_states
 
     # -- train step ----------------------------------------------------------
 
@@ -249,7 +277,11 @@ class MultiLayerNetwork:
             for conf, p in zip(self.layer_confs, self.params_list)
         ]
 
-    def _build_train_step(self):
+    def _make_step(self, loss_builder):
+        """Generic jitted optimizer step around a loss builder
+        (p, states, data, rng) -> (score, new_states). The tail — gradient
+        masking/normalization, per-leaf lr, updater, param update — is
+        shared by the standard and truncated-backward steps."""
         gnorm = self.net_conf.gradient_normalization
         gthresh = self.net_conf.gradient_normalization_threshold
         mults = self._lr_mult_tree()
@@ -257,9 +289,9 @@ class MultiLayerNetwork:
         updater = self.updater_def
         minimize = self.net_conf.minimize
 
-        def step(params, states, upd_state, x, y, f_mask, l_mask, lr, t, rng):
+        def step(params, states, upd_state, data, lr, t, rng):
             def loss_fn(p):
-                return self._loss(p, states, x, y, f_mask, l_mask, rng)
+                return loss_builder(p, states, data, rng)
 
             (score, new_states), grads = jax.value_and_grad(
                 loss_fn, has_aux=True
@@ -282,20 +314,51 @@ class MultiLayerNetwork:
         donate = (0, 2) if backend != "cpu" else ()
         return jax.jit(step, donate_argnums=donate)
 
-    def _fit_step(self, x, y, f_mask, l_mask, stateful_states=None):
-        """One optimizer step. Returns the (device) score."""
-        if self._train_step_fn is None:
-            self._train_step_fn = self._build_train_step()
+    def _build_train_step(self):
+        def loss_builder(p, states, data, rng):
+            x, y, f_mask, l_mask = data
+            return self._loss(p, states, x, y, f_mask, l_mask, rng)
+
+        return self._make_step(loss_builder)
+
+    def _build_truncated_bwd_step(self):
+        """TBPTT segment step with tbptt_bwd_length < tbptt_fwd_length:
+        the segment's leading (fwd-bwd) timesteps run under stop_gradient
+        (state advances, loss counts, but no gradient flows back through
+        them), truncating backprop depth to bwd_length (reference:
+        tBPTTBackwardLength, MultiLayerNetwork.java:1333; the reference
+        zeroes epsilons past bwd steps of the reverse walk — here the cut
+        is a stop_gradient on the carried state at the boundary)."""
+
+        def loss_builder(p, states, data, rng):
+            xA, yA, fmA, lmA, xB, yB, fmB, lmB = data
+            lossA, statesA = self._loss(p, states, xA, yA, fmA, lmA, rng)
+            carried = self._merge_states(states, statesA)
+            carried = jax.tree_util.tree_map(jax.lax.stop_gradient, carried)
+            lossB, statesB = self._loss(
+                p, carried, xB, yB, fmB, lmB,
+                None if rng is None else jax.random.fold_in(rng, 1),
+            )
+            nA, nB = xA.shape[1], xB.shape[1]
+            # slice A contributes to the reported score but NOT to the
+            # gradient (stop_gradient lets XLA prune its whole backward
+            # pass) — backprop depth is exactly bwd_length
+            score = (
+                jax.lax.stop_gradient(lossA) * nA + lossB * nB
+            ) / (nA + nB)
+            return score, self._merge_states(carried, statesB)
+
+        return self._make_step(loss_builder)
+
+    def _run_step(self, step_fn, data, stateful_states=None):
         lr = schedule_lr(self.net_conf, self.iteration)
         rng = jax.random.fold_in(
             jax.random.PRNGKey(self.net_conf.seed ^ 0x5EED), self.iteration
         )
         states = stateful_states if stateful_states is not None else self.state_list
-        params, states, upd, score = self._train_step_fn(
+        params, states, upd, score = step_fn(
             self.params_list, states, self.upd_state,
-            jnp.asarray(x), jnp.asarray(y),
-            None if f_mask is None else jnp.asarray(f_mask),
-            None if l_mask is None else jnp.asarray(l_mask),
+            tuple(None if a is None else jnp.asarray(a) for a in data),
             jnp.asarray(lr, jnp.float32), jnp.asarray(float(self.iteration)),
             rng,
         )
@@ -305,30 +368,132 @@ class MultiLayerNetwork:
         self.iteration += 1
         return states, score
 
+    def _fit_step(self, x, y, f_mask, l_mask, stateful_states=None):
+        """One optimizer step. Returns the (device) score."""
+        if self._train_step_fn is None:
+            self._train_step_fn = self._build_train_step()
+        return self._run_step(
+            self._train_step_fn, (x, y, f_mask, l_mask), stateful_states
+        )
+
+    def _fit_step_truncated(self, dataA, dataB, stateful_states):
+        """One TBPTT segment step with a backward-truncation boundary
+        between slice A (state-carry, stop-gradient) and slice B."""
+        if getattr(self, "_trunc_step_fn", None) is None:
+            self._trunc_step_fn = self._build_truncated_bwd_step()
+        return self._run_step(
+            self._trunc_step_fn, dataA + dataB, stateful_states
+        )
+
+    # -- pretraining ---------------------------------------------------------
+
+    _PRETRAINABLE = (L.AutoEncoder, L.VariationalAutoencoder, L.RBM)
+
+    def pretrain(self, data, *, epochs: int = 1, batch_size: int = 32):
+        """Layerwise unsupervised pretraining: each pretrainable layer
+        (AutoEncoder / VAE / RBM) trains on the activations of the frozen
+        stack below it (reference: MultiLayerNetwork.pretrain/pretrainLayer
+        :210-287)."""
+        self._require_init()
+        for i, conf in enumerate(self.layer_confs):
+            if isinstance(conf, self._PRETRAINABLE):
+                self.pretrain_layer(i, data, epochs=epochs, batch_size=batch_size)
+        return self
+
+    def pretrain_layer(self, idx: int, data, *, epochs: int = 1,
+                       batch_size: int = 32):
+        """Unsupervised fit of one layer. Objectives: AutoEncoder =
+        reconstruction loss through tied-weight decode; VAE = negative
+        ELBO (special.py vae_elbo); RBM = CD-k (rbm.py rbm_cd_stats)."""
+        conf = self.layer_confs[idx]
+        if not isinstance(conf, self._PRETRAINABLE):
+            raise ValueError(
+                f"layer {idx} ({type(conf).__name__}) is not pretrainable"
+            )
+        if isinstance(data, DataSetIterator):
+            iterator = data
+        elif isinstance(data, DataSet):
+            iterator = ListDataSetIterator(data, batch_size)
+        else:  # raw features; labels are unused in unsupervised fit
+            x = np.asarray(data)
+            iterator = ListDataSetIterator(DataSet(x, x), batch_size)
+        feed = jax.jit(
+            lambda params, states, x: self._forward(
+                params, states, self.policy.cast_input(x),
+                training=False, rng=None, to_layer=idx,
+            )[0]
+        )
+        step = self._build_pretrain_step(conf)
+        upd_state = self.updater_def.init_tree(self.params_list[idx])
+        it_count = 0
+        for _ in range(epochs):
+            for ds in iterator:
+                x_in = feed(self.params_list, self.state_list,
+                            jnp.asarray(ds.features))
+                lr = schedule_lr(self.net_conf, it_count)
+                rng = jax.random.fold_in(
+                    jax.random.PRNGKey(self.net_conf.seed ^ (0xBEEF + idx)),
+                    it_count,
+                )
+                new_p, upd_state, score = step(
+                    self.params_list[idx], upd_state, x_in,
+                    jnp.asarray(lr, jnp.float32),
+                    jnp.asarray(float(it_count)), rng,
+                )
+                self.params_list = (
+                    self.params_list[:idx] + [new_p] + self.params_list[idx + 1:]
+                )
+                self._score = score
+                it_count += 1
+            iterator.reset()
+        return self
+
+    def _build_pretrain_step(self, conf):
+        updater = self.updater_def
+
+        def step(layer_params, upd_state, x_in, lr, t, rng):
+            if isinstance(conf, L.RBM):
+                from deeplearning4j_tpu.nn.layers.rbm import rbm_cd_stats
+
+                grads, per_ex = rbm_cd_stats(conf, layer_params, x_in, rng)
+                score = jnp.mean(per_ex)
+            else:
+                def objective(p):
+                    if isinstance(conf, L.VariationalAutoencoder):
+                        from deeplearning4j_tpu.nn.layers.special import vae_elbo
+
+                        return jnp.mean(vae_elbo(conf, p, x_in, rng))
+                    from deeplearning4j_tpu.nn.layers.core import (
+                        autoencoder_reconstruct,
+                    )
+
+                    ctx = LayerContext(training=True, rng=rng)
+                    recon = autoencoder_reconstruct(conf, p, x_in, ctx)
+                    per_ex = loss_value(conf.loss, x_in, recon, "identity", None)
+                    return jnp.mean(per_ex)
+
+                score, grads = jax.value_and_grad(objective)(layer_params)
+            updates, new_upd = updater.apply_tree(grads, upd_state, lr, t)
+            new_params = jax.tree_util.tree_map(jnp.add, layer_params, updates)
+            return new_params, new_upd, score
+
+        return jax.jit(step)
+
     # -- fit -----------------------------------------------------------------
 
     def fit(self, data, labels=None, *, epochs: int = 1, batch_size: int = 32,
             async_prefetch: bool = True):
         """Train. Accepts (features, labels) arrays, a DataSet, or a
         DataSetIterator (reference: MultiLayerNetwork.fit overloads
-        :1019)."""
+        :1019). If the configuration sets pretrain=True, layerwise
+        unsupervised pretraining runs once before the first backprop epoch
+        (reference: fit() pretrain dispatch :210)."""
         self._require_init()
+        if self.conf.pretrain and not getattr(self, "_pretrained", False):
+            self.pretrain(data, batch_size=batch_size)
+            self._pretrained = True
         iterator = self._as_iterator(data, labels, batch_size)
-        if async_prefetch and not isinstance(iterator, AsyncDataSetIterator):
-            iterator = AsyncDataSetIterator(iterator)
-        for ep in range(epochs):
-            for lst in self.listeners:
-                lst.on_epoch_start(self, self.epoch)
-            t_etl = time.perf_counter()
-            for ds in iterator:
-                self._last_etl_ms = (time.perf_counter() - t_etl) * 1e3
-                self._fit_dataset(ds)
-                t_etl = time.perf_counter()
-            for lst in self.listeners:
-                lst.on_epoch_end(self, self.epoch)
-            self.epoch += 1
-            iterator.reset()
-        return self
+        return self._run_fit(iterator, epochs, async_prefetch)
 
     def _as_iterator(self, data, labels, batch_size) -> DataSetIterator:
         if isinstance(data, DataSetIterator):
@@ -340,8 +505,10 @@ class MultiLayerNetwork:
         return ListDataSetIterator(DataSet(x, y), batch_size)
 
     def _fit_dataset(self, ds: DataSet):
-        if self._batch_transform is not None:
-            ds = self._batch_transform(ds)
+        algo = self.net_conf.optimization_algo
+        if algo != "sgd":
+            self._fit_line_search(ds, algo)
+            return
         tbptt = (
             self.conf.backprop_type == BackpropType.TRUNCATED_BPTT
             and ds.features.ndim == 3
@@ -355,25 +522,68 @@ class MultiLayerNetwork:
             self.state_list = states
             self._notify(ds.num_examples())
 
+    def _fit_line_search(self, ds: DataSet, algo: str):
+        """Line-search optimizer path (LBFGS/CG/line GD): host-side search
+        loop around the compiled value+gradient function (reference:
+        BaseOptimizer.optimize :182-230). One optimize() call per batch."""
+        from deeplearning4j_tpu.nn.params import flat_to_params, params_to_flat
+        from deeplearning4j_tpu.train.solvers import (
+            _FlatProblem,
+            make_line_search_optimizer,
+        )
+
+        if getattr(self, "_solver", None) is None or self._solver.name != algo:
+            self._solver = make_line_search_optimizer(algo)
+            self._flat_problem = _FlatProblem(self)
+        x = jnp.asarray(ds.features)
+        y = jnp.asarray(ds.labels)
+        fm = None if ds.features_mask is None else jnp.asarray(ds.features_mask)
+        lm = None if ds.labels_mask is None else jnp.asarray(ds.labels_mask)
+        rng = jax.random.fold_in(
+            jax.random.PRNGKey(self.net_conf.seed ^ 0x5EED), self.iteration
+        )
+        problem = self._flat_problem.bind(self.state_list, x, y, fm, lm, rng)
+        flat = params_to_flat(self.layer_confs, self.params_list)
+        step0 = schedule_lr(self.net_conf, self.iteration)
+        new_flat, f_new = self._solver.optimize(problem, flat, step0)
+        self.params_list = flat_to_params(self.layer_confs, self.params_list, new_flat)
+        self._score = jnp.asarray(f_new)
+        self.iteration += 1
+        self._notify(ds.num_examples())
+
     def _fit_tbptt(self, ds: DataSet):
         """Truncated BPTT: split time into segments of tbptt_fwd_length and
         carry RNN state across segments (reference:
-        MultiLayerNetwork.doTruncatedBPTT :1333)."""
+        MultiLayerNetwork.doTruncatedBPTT :1333). When tbptt_bwd_length <
+        tbptt_fwd_length, each segment's gradient is truncated to its last
+        bwd_length timesteps (config tBPTTBackwardLength)."""
         T = ds.features.shape[1]
         seg = int(self.conf.tbptt_fwd_length)
+        bwd = int(self.conf.tbptt_bwd_length)
         # seed zero RNN state for recurrent layers
         states = list(self.state_list)
         for i, conf in enumerate(self.layer_confs):
             if _is_recurrent(conf) and states[i] is None:
                 states[i] = {}
-        for start in range(0, T, seg):
-            sl = slice(start, min(start + seg, T))
+
+        def cut(sl):
             fm = None if ds.features_mask is None else ds.features_mask[:, sl]
             lm = None if ds.labels_mask is None else ds.labels_mask[:, sl]
             labels = ds.labels[:, sl] if ds.labels.ndim == 3 else ds.labels
-            states, _ = self._fit_step(
-                ds.features[:, sl], labels, fm, lm, stateful_states=states
-            )
+            return (ds.features[:, sl], labels, fm, lm)
+
+        for start in range(0, T, seg):
+            end = min(start + seg, T)
+            if bwd < end - start:
+                boundary = end - bwd
+                states, _ = self._fit_step_truncated(
+                    cut(slice(start, boundary)), cut(slice(boundary, end)),
+                    stateful_states=states,
+                )
+            else:
+                states, _ = self._fit_step(
+                    *cut(slice(start, end)), stateful_states=states
+                )
             self._notify(ds.num_examples())
         # persist only non-RNN state (running stats); RNN carry is per-batch
         self.state_list = [
@@ -381,30 +591,29 @@ class MultiLayerNetwork:
             for i, (conf, st) in enumerate(zip(self.layer_confs, states))
         ]
 
-    def _notify(self, batch_size):
-        if not self.listeners:
-            return
-        info = {
-            "score": lambda: self._score,
-            "batch_size": batch_size,
-            "etl_ms": self._last_etl_ms,
-        }
-        for lst in self.listeners:
-            lst.iteration_done(self, self.iteration - 1, info)
-
     # -- inference -----------------------------------------------------------
 
     def output(self, x, training: bool = False):
-        """Full forward pass (reference: MultiLayerNetwork.output)."""
+        """Full forward pass (reference: MultiLayerNetwork.output).
+        training=True gives train-mode activations (dropout active, batch
+        statistics) with a deterministic per-call rng."""
         self._require_init()
         if self._output_fn is None:
-            def fwd(params, states, xx):
+            self._output_fn = {}
+        if training not in self._output_fn:
+            def fwd(params, states, xx, rng):
                 xx = self.policy.cast_input(xx)
-                out, _ = self._forward(params, states, xx, training=False, rng=None)
+                out, _ = self._forward(params, states, xx,
+                                       training=training, rng=rng)
                 return self.policy.cast_output(out)
 
-            self._output_fn = jax.jit(fwd)
-        return self._output_fn(self.params_list, self.state_list, jnp.asarray(x))
+            self._output_fn[training] = jax.jit(fwd)
+        rng = (
+            jax.random.PRNGKey(self.net_conf.seed ^ 0xD0) if training else None
+        )
+        return self._output_fn[training](
+            self.params_list, self.state_list, jnp.asarray(x), rng
+        )
 
     def feed_forward(self, x):
         """Per-layer activations list (reference: feedForward family
@@ -493,37 +702,6 @@ class MultiLayerNetwork:
 
     def rnn_clear_previous_state(self):
         self._rnn_states = None
-
-    # -- params API ----------------------------------------------------------
-
-    def params(self) -> jnp.ndarray:
-        """Flattened parameter vector (reference: Model.params())."""
-        self._require_init()
-        return params_to_flat(self.layer_confs, self.params_list)
-
-    def set_params(self, flat):
-        self._require_init()
-        self.params_list = flat_to_params(self.layer_confs, self.params_list, flat)
-
-    def num_params(self) -> int:
-        self._require_init()
-        return num_params(self.layer_confs, self.params_list)
-
-    def param_table(self):
-        self._require_init()
-        return param_table(self.layer_confs, self.params_list)
-
-    def summary(self) -> str:
-        self._require_init()
-        lines = ["=" * 70]
-        total = 0
-        for i, (conf, p) in enumerate(zip(self.layer_confs, self.params_list)):
-            n = sum(int(np.prod(v.shape)) for v in p.values())
-            total += n
-            lines.append(f"{i:>3}  {type(conf).__name__:<28} params: {n}")
-        lines.append(f"total params: {total}")
-        lines.append("=" * 70)
-        return "\n".join(lines)
 
     def clone(self) -> "MultiLayerNetwork":
         import copy

@@ -1,0 +1,138 @@
+"""Shared network machinery for MultiLayerNetwork and ComputationGraph.
+
+The reference factors this via the Model interface + BaseLayer inheritance
+(nn/api/Model.java); here it is a small base class holding the pieces that
+are identical for sequential and DAG networks: listener management, the
+epoch/iteration fit loop (with async prefetch and ETL timing), the
+batch-transform hook used by parallel.ParallelWrapper, and the flattened
+parameter view API (params()/setParams(), reference:
+MultiLayerNetwork.java:102-104 flattenedParams).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.data.iterators import AsyncDataSetIterator
+from deeplearning4j_tpu.nn.params import (
+    flat_to_params,
+    num_params,
+    param_table,
+    params_to_flat,
+)
+
+
+class NetworkBase:
+    """Common trainable-network state + loops. Subclasses implement
+    `_fit_dataset(ds)` (one optimizer step or TBPTT segment loop) and
+    `_ordered_layer_confs()` (layer configs aligned with params_list)."""
+
+    def __init__(self):
+        self.listeners = []
+        self.iteration = 0
+        self.epoch = 0
+        self.params_list = None
+        self.state_list = None
+        self.upd_state = None
+        self._score = None  # last minibatch score (device array, lazy read)
+        self._last_etl_ms = 0.0
+        # hook applied to each DataSet before the step — installed by
+        # parallel.ParallelWrapper to shard the batch across the mesh
+        self._batch_transform = None
+
+    # -- to be provided by subclasses ----------------------------------------
+
+    def init(self):
+        raise NotImplementedError
+
+    def _fit_dataset(self, ds):
+        raise NotImplementedError
+
+    def _ordered_layer_confs(self) -> List:
+        """Layer configs in flattening order, aligned with params_list."""
+        raise NotImplementedError
+
+    def _require_init(self):
+        if self.params_list is None:
+            self.init()
+
+    # -- listeners -----------------------------------------------------------
+
+    def set_listeners(self, *listeners):
+        self.listeners = list(listeners)
+        return self
+
+    def add_listener(self, listener):
+        self.listeners.append(listener)
+        return self
+
+    def _notify(self, batch_size):
+        if not self.listeners:
+            return
+        info = {
+            "score": lambda: self._score,
+            "batch_size": batch_size,
+            "etl_ms": self._last_etl_ms,
+        }
+        for lst in self.listeners:
+            lst.iteration_done(self, self.iteration - 1, info)
+
+    # -- the fit loop --------------------------------------------------------
+
+    def _run_fit(self, iterator, epochs: int, async_prefetch: bool,
+                 prefetch_buffer: int = 4):
+        if async_prefetch and not isinstance(iterator, AsyncDataSetIterator):
+            iterator = AsyncDataSetIterator(iterator, prefetch_buffer)
+        for _ in range(epochs):
+            for lst in self.listeners:
+                lst.on_epoch_start(self, self.epoch)
+            t_etl = time.perf_counter()
+            for ds in iterator:
+                self._last_etl_ms = (time.perf_counter() - t_etl) * 1e3
+                if self._batch_transform is not None:
+                    ds = self._batch_transform(ds)
+                self._fit_dataset(ds)
+                t_etl = time.perf_counter()
+            for lst in self.listeners:
+                lst.on_epoch_end(self, self.epoch)
+            self.epoch += 1
+            iterator.reset()
+        return self
+
+    # -- flattened params API ------------------------------------------------
+
+    def params(self):
+        """Flattened parameter vector (reference: Model.params())."""
+        self._require_init()
+        return params_to_flat(self._ordered_layer_confs(), self.params_list)
+
+    def set_params(self, flat):
+        self._require_init()
+        self.params_list = flat_to_params(
+            self._ordered_layer_confs(), self.params_list, flat
+        )
+
+    def num_params(self) -> int:
+        self._require_init()
+        return num_params(self._ordered_layer_confs(), self.params_list)
+
+    def param_table(self):
+        self._require_init()
+        return param_table(self._ordered_layer_confs(), self.params_list)
+
+    def summary(self) -> str:
+        self._require_init()
+        lines = ["=" * 70]
+        total = 0
+        for i, (conf, p) in enumerate(
+            zip(self._ordered_layer_confs(), self.params_list)
+        ):
+            n = sum(int(np.prod(v.shape)) for v in p.values())
+            total += n
+            lines.append(f"{i:>3}  {type(conf).__name__:<28} params: {n}")
+        lines.append(f"total params: {total}")
+        lines.append("=" * 70)
+        return "\n".join(lines)

@@ -1,0 +1,216 @@
+"""Transfer learning: builder + featurizing helper.
+
+Reference: nn/transferlearning/TransferLearning.java (808 LoC) —
+fineTuneConfiguration (hyperparameter overrides), setFeatureExtractor
+(freeze up to a boundary via FrozenLayer), nOutReplace (swap a layer's
+width + reinitialize it and its consumer), removeOutputLayer/addLayer; and
+TransferLearningHelper (featurize a dataset through the frozen front so
+repeated fine-tune epochs skip recomputing it).
+
+Functional design: the builder never mutates the source network — it
+produces a NEW MultiLayerNetwork whose configs are deep copies and whose
+parameter arrays are shared (jax arrays are immutable, so sharing is safe)
+except where a replace/add forces re-initialization.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Dict, List, Optional
+
+import jax
+import numpy as np
+
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.nn.conf.network import MultiLayerConfiguration
+from deeplearning4j_tpu.nn.layers.registry import init_layer_params, init_layer_state
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+class TransferLearning:
+    class Builder:
+        def __init__(self, net: MultiLayerNetwork):
+            net._require_init()
+            self._src = net
+            self._fine_tune: Dict = {}
+            self._freeze_until: Optional[int] = None
+            self._replacements: Dict[int, dict] = {}
+            self._removed_from_output = 0
+            self._added: List[L.LayerConf] = []
+
+        def fine_tune_configuration(self, **overrides) -> "TransferLearning.Builder":
+            """Override global hyperparameters (learning_rate, updater,
+            momentum, ... — reference: FineTuneConfiguration)."""
+            self._fine_tune.update(overrides)
+            return self
+
+        def set_feature_extractor(self, layer_idx: int) -> "TransferLearning.Builder":
+            """Freeze layers 0..layer_idx inclusive (reference:
+            setFeatureExtractor — wraps in FrozenLayer)."""
+            self._freeze_until = int(layer_idx)
+            return self
+
+        def n_out_replace(self, layer_idx: int, n_out: int,
+                          weight_init: Optional[str] = None) -> "TransferLearning.Builder":
+            """Change layer_idx's n_out and reinitialize it + the next
+            parameterized layer's n_in (reference: nOutReplace)."""
+            self._replacements[int(layer_idx)] = {
+                "n_out": int(n_out), "weight_init": weight_init,
+            }
+            return self
+
+        def remove_output_layer(self) -> "TransferLearning.Builder":
+            return self.remove_layers_from_output(1)
+
+        def remove_layers_from_output(self, n: int) -> "TransferLearning.Builder":
+            self._removed_from_output += int(n)
+            return self
+
+        def add_layer(self, layer_conf: L.LayerConf) -> "TransferLearning.Builder":
+            self._added.append(layer_conf)
+            return self
+
+        def build(self) -> MultiLayerNetwork:
+            src = self._src
+            confs = [copy.deepcopy(c) for c in src.layer_confs]
+            keep = len(confs) - self._removed_from_output
+            if keep < 0:
+                raise ValueError("removed more layers than the network has")
+            confs = confs[:keep]
+            reinit = set()
+
+            # nOutReplace: new width + downstream n_in rewiring
+            for idx, spec in sorted(self._replacements.items()):
+                if idx >= len(confs):
+                    raise ValueError(f"n_out_replace index {idx} out of range")
+                inner = confs[idx].inner if isinstance(confs[idx], L.FrozenLayer) else confs[idx]
+                inner.n_out = spec["n_out"]
+                if spec["weight_init"]:
+                    inner.weight_init = spec["weight_init"]
+                reinit.add(idx)
+                for j in range(idx + 1, len(confs)):
+                    nxt = confs[j].inner if isinstance(confs[j], L.FrozenLayer) else confs[j]
+                    if isinstance(nxt, L.BatchNormalization):
+                        nxt.n_in = spec["n_out"]
+                        reinit.add(j)
+                        continue
+                    if isinstance(nxt, L.FeedForwardLayerConf):
+                        nxt.n_in = spec["n_out"]
+                        reinit.add(j)
+                        break
+                    if nxt.has_params():
+                        break
+
+            # added layers: wire n_in from the previous feed-forward width
+            prev_out = None
+            for c in reversed(confs):
+                inner = c.inner if isinstance(c, L.FrozenLayer) else c
+                if isinstance(inner, L.FeedForwardLayerConf):
+                    prev_out = inner.n_out
+                    break
+            for lc in self._added:
+                inner = lc.inner if isinstance(lc, L.FrozenLayer) else lc
+                if isinstance(inner, L.FeedForwardLayerConf) and inner.n_in is None:
+                    inner.n_in = prev_out
+                if isinstance(inner, L.FeedForwardLayerConf):
+                    prev_out = inner.n_out
+                reinit.add(len(confs))
+                confs.append(lc)
+
+            # freeze the feature extractor
+            if self._freeze_until is not None:
+                for i in range(min(self._freeze_until + 1, len(confs))):
+                    if not isinstance(confs[i], L.FrozenLayer):
+                        confs[i] = L.FrozenLayer(inner=confs[i])
+
+            net_conf = copy.deepcopy(src.net_conf)
+            for k, v in self._fine_tune.items():
+                if not hasattr(net_conf, k):
+                    raise ValueError(f"unknown fine-tune hyperparameter {k!r}")
+                setattr(net_conf, k, v)
+
+            # added layers inherit network defaults exactly as the
+            # ListBuilder does for an original build
+            from deeplearning4j_tpu.nn.conf.network import _apply_defaults
+
+            for lc in self._added:
+                _apply_defaults(lc, net_conf)
+
+            new_conf = MultiLayerConfiguration(
+                net_conf=net_conf,
+                layers=confs,
+                preprocessors=copy.deepcopy(src.conf.preprocessors),
+                backprop_type=src.conf.backprop_type,
+                tbptt_fwd_length=src.conf.tbptt_fwd_length,
+                tbptt_bwd_length=src.conf.tbptt_bwd_length,
+                input_type=copy.deepcopy(src.conf.input_type),
+            )
+            new_net = MultiLayerNetwork(new_conf).init()
+            # parameter transfer: share surviving layers' arrays, keep the
+            # fresh init for replaced/added layers
+            for i in range(len(confs)):
+                if i < len(src.params_list) and i not in reinit:
+                    new_net.params_list[i] = src.params_list[i]
+                    s = src.state_list[i]
+                    new_net.state_list[i] = None if s is None else dict(s)
+            return new_net
+
+
+class TransferLearningHelper:
+    """Featurize through the frozen front once, then fine-tune the
+    unfrozen tail on cached features (reference:
+    nn/transferlearning/TransferLearningHelper.java)."""
+
+    def __init__(self, net: MultiLayerNetwork):
+        net._require_init()
+        self.net = net
+        self.boundary = 0
+        for i, c in enumerate(net.layer_confs):
+            if isinstance(c, L.FrozenLayer):
+                self.boundary = i + 1
+        if self.boundary == 0:
+            raise ValueError("network has no frozen layers to featurize through")
+        self._feed = jax.jit(
+            lambda params, states, x: net._forward(
+                params, states, net.policy.cast_input(x),
+                training=False, rng=None, to_layer=self.boundary,
+            )[0]
+        )
+        # the unfrozen tail as its own network sharing parameter arrays
+        tail_confs = [copy.deepcopy(c) for c in net.layer_confs[self.boundary:]]
+        tail_conf = MultiLayerConfiguration(
+            net_conf=copy.deepcopy(net.net_conf),
+            layers=tail_confs,
+            preprocessors={
+                str(int(k) - self.boundary): v
+                for k, v in net.conf.preprocessors.items()
+                if int(k) >= self.boundary
+            },
+        )
+        self.tail = MultiLayerNetwork(tail_conf).init()
+        self.tail.params_list = list(net.params_list[self.boundary:])
+        self.tail.state_list = [
+            None if s is None else dict(s)
+            for s in net.state_list[self.boundary:]
+        ]
+
+    def featurize(self, ds: DataSet) -> DataSet:
+        feats = self._feed(self.net.params_list, self.net.state_list,
+                           np.asarray(ds.features))
+        return DataSet(np.asarray(feats), ds.labels, None, ds.labels_mask)
+
+    def fit_featurized(self, data, labels=None, *, epochs: int = 1,
+                       batch_size: int = 32):
+        """Train the unfrozen tail on featurized data, then write the
+        updated parameters back into the full network."""
+        self.tail.fit(data, labels, epochs=epochs, batch_size=batch_size,
+                      async_prefetch=False)
+        for i, p in enumerate(self.tail.params_list):
+            self.net.params_list[self.boundary + i] = p
+        for i, s in enumerate(self.tail.state_list):
+            self.net.state_list[self.boundary + i] = s
+        return self.net
+
+    def unfrozen_network(self) -> MultiLayerNetwork:
+        return self.tail

@@ -61,3 +61,13 @@ def data_shards(mesh: Mesh) -> int:
     """Number of shards along the data axis (NOT the total device count —
     on a 2-D data×model mesh only the data axis splits the batch)."""
     return int(mesh.shape[DATA_AXIS])
+
+
+def placement_for_batch(mesh: Mesh, n_examples: int) -> NamedSharding:
+    """Placement policy for a batch of n examples: shard dim 0 over the
+    data axis when divisible, otherwise fall back to replicated (the tail
+    batch of an epoch) — still correct, just not distributed. The single
+    source of truth for training AND serving paths."""
+    if n_examples % data_shards(mesh) == 0:
+        return batch_sharded(mesh)
+    return replicated(mesh)

@@ -35,12 +35,12 @@ import logging
 import jax
 import numpy as np
 
-from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet
 from deeplearning4j_tpu.data.iterators import DataSetIterator, StackedDataSetIterator
 from deeplearning4j_tpu.parallel.mesh import (
-    batch_sharded,
     data_parallel_mesh,
     data_shards,
+    placement_for_batch,
     replicated,
 )
 
@@ -100,14 +100,19 @@ class ParallelWrapper:
         self.model.params_list = put(self.model.params_list)
         self.model.upd_state = put(self.model.upd_state)
 
-    def _shard_batch(self, ds: DataSet) -> DataSet:
-        """Shard a global batch's dim 0 across the data axis. Falls back to
-        replicated placement when the batch is not divisible by the shard
-        count (the tail batch of an epoch) — still correct, just not
-        distributed."""
-        n = ds.num_examples()
-        sh = batch_sharded(self.mesh) if n % self.n_shards == 0 else replicated(self.mesh)
+    def _shard_batch(self, ds):
+        """Shard a global batch's dim 0 across the data axis (DataSet or
+        MultiDataSet — ComputationGraph fit yields the latter)."""
+        sh = placement_for_batch(self.mesh, ds.num_examples())
         put = lambda a: None if a is None else jax.device_put(np.asarray(a), sh)
+        if isinstance(ds, MultiDataSet):
+            put_list = lambda arrs: None if arrs is None else [put(a) for a in arrs]
+            return MultiDataSet(
+                [put(f) for f in ds.features],
+                [put(l) for l in ds.labels],
+                put_list(ds.features_masks),
+                put_list(ds.labels_masks),
+            )
         return DataSet(
             put(ds.features),
             put(ds.labels),
@@ -144,9 +149,5 @@ class ParallelWrapper:
         """Data-parallel forward pass: shards the batch, same replicated
         params."""
         xx = np.asarray(x)
-        sh = (
-            batch_sharded(self.mesh)
-            if xx.shape[0] % self.n_shards == 0
-            else replicated(self.mesh)
-        )
+        sh = placement_for_batch(self.mesh, xx.shape[0])
         return self.model.output(jax.device_put(xx, sh))

@@ -118,6 +118,59 @@ def check_gradients(net, x, y, features_mask=None, labels_mask=None,
         net.policy = saved_policy
 
 
+def check_gradients_graph(net, xs, ys, features_masks=None, labels_masks=None,
+                          epsilon: float = 1e-6, max_rel_error: float = 1e-5,
+                          min_abs_error: float = 1e-8,
+                          max_checks: Optional[int] = None,
+                          verbose: bool = False) -> bool:
+    """Gradient-check a ComputationGraph (reference:
+    GradientCheckUtil.checkGradients(ComputationGraph, ...) and the
+    GradientCheckTestsComputationGraph suite). xs/ys are lists aligned with
+    the graph's inputs/outputs."""
+    from deeplearning4j_tpu.common.dtypes import PrecisionPolicy
+    from deeplearning4j_tpu.nn.params import flat_to_params, params_to_flat
+
+    net._require_init()
+    saved_policy = net.policy
+    net.policy = PrecisionPolicy(
+        param_dtype=jnp.float64, compute_dtype=jnp.float64, output_dtype=jnp.float64
+    )
+    try:
+        with jax.enable_x64():
+            confs = net._ordered_layer_confs()
+            params64 = jax.tree_util.tree_map(
+                lambda a: jnp.asarray(np.asarray(a, dtype=np.float64)),
+                net.params_list,
+            )
+            states64 = [
+                None if s is None else {k: jnp.asarray(np.asarray(v, np.float64))
+                                        for k, v in s.items()}
+                for s in net.state_list
+            ]
+            xs64 = [jnp.asarray(np.asarray(x, np.float64)) for x in xs]
+            ys64 = [jnp.asarray(np.asarray(y, np.float64)) for y in ys]
+            as64 = lambda ms: None if ms is None else [
+                None if m is None else jnp.asarray(np.asarray(m, np.float64))
+                for m in ms
+            ]
+            fms, lms = as64(features_masks), as64(labels_masks)
+
+            def loss_of_flat(flat):
+                plist = flat_to_params(confs, params64, flat)
+                s, _ = net._loss(plist, states64, xs64, ys64, fms, lms,
+                                 rng=None, training=True)
+                return s
+
+            flat0 = params_to_flat(confs, params64)
+            return check_gradients_fn(
+                loss_of_flat, np.asarray(flat0), epsilon=epsilon,
+                max_rel_error=max_rel_error, min_abs_error=min_abs_error,
+                max_checks=max_checks, verbose=verbose,
+            )
+    finally:
+        net.policy = saved_policy
+
+
 def _check_gradients_x64(net, x, y, features_mask, labels_mask, epsilon,
                          max_rel_error, min_abs_error, max_checks, verbose):
     from deeplearning4j_tpu.nn.params import flat_to_params

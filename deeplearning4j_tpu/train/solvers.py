@@ -1,0 +1,230 @@
+"""Line-search (full-gradient) optimizers: line gradient descent,
+conjugate gradient, L-BFGS.
+
+Reference: optimize/solvers/ — LineGradientDescent, ConjugateGradient,
+LBFGS over BaseOptimizer (line-search optimize() :182-230) with
+BackTrackLineSearch (Armijo backtracking). These run the model's compiled
+value+gradient function inside a host-side search loop: the per-evaluation
+math is one jitted XLA call on the flat parameter vector, the search logic
+(direction update, step halving) is Python — the same split as the
+reference's Java-loop-around-native-ops, with XLA in place of libnd4j.
+
+SGD remains the fast path (one fused jitted step, train/updaters.py);
+these optimizers trade steps/sec for better per-batch convergence, exactly
+as in the reference.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class BackTrackLineSearch:
+    """Armijo backtracking (reference: optimize/solvers/BackTrackLineSearch
+    .java): try the full step, halve until sufficient decrease or maxIter."""
+
+    def __init__(self, c1: float = 1e-4, rho: float = 0.5, max_iterations: int = 5):
+        self.c1 = c1
+        self.rho = rho
+        self.max_iterations = max_iterations
+
+    def search(self, value_fn: Callable, x0, f0, g0, direction, step0: float):
+        """Returns (x_new, f_new, step_taken)."""
+        slope = float(jnp.vdot(g0, direction))
+        if slope >= 0:
+            # not a descent direction — caller should reset (CG/LBFGS do)
+            return x0, f0, 0.0
+        step = step0
+        for i in range(self.max_iterations):
+            x_new = x0 + step * direction
+            f_new = float(value_fn(x_new))
+            if np.isfinite(f_new) and f_new <= f0 + self.c1 * step * slope:
+                if i == 0:
+                    # full step accepted — expand while it keeps helping
+                    # (reference: BackTrackLineSearch stpmax probing)
+                    for _ in range(self.max_iterations):
+                        x_try = x0 + 2.0 * step * direction
+                        f_try = float(value_fn(x_try))
+                        ok = (
+                            np.isfinite(f_try)
+                            and f_try <= f0 + self.c1 * 2.0 * step * slope
+                            and f_try < f_new
+                        )
+                        if not ok:
+                            break
+                        step *= 2.0
+                        x_new, f_new = x_try, f_try
+                return x_new, f_new, step
+            step *= self.rho  # backtrack
+        return x0, f0, 0.0
+
+
+class _FlatProblem:
+    """value_and_grad of the network loss as a function of the flat param
+    vector. Jitted ONCE on the network (batch data are traced arguments, so
+    successive batches reuse the compiled program)."""
+
+    def __init__(self, net):
+        from deeplearning4j_tpu.nn.params import flat_to_params
+
+        confs = net._ordered_layer_confs()
+        params0 = net.params_list
+
+        def loss_of_flat(flat, states, x, y, f_mask, l_mask, rng):
+            plist = flat_to_params(confs, params0, flat)
+            s, _ = net._loss(plist, states, x, y, f_mask, l_mask,
+                             rng=rng, training=True)
+            return s
+
+        self._vg = jax.jit(jax.value_and_grad(loss_of_flat))
+        self._v = jax.jit(loss_of_flat)
+        self._bound = None
+
+    def bind(self, states, x, y, f_mask, l_mask, rng) -> "_FlatProblem":
+        self._bound = (states, x, y, f_mask, l_mask, rng)
+        return self
+
+    def value_and_grad(self, flat):
+        return self._vg(flat, *self._bound)
+
+    def value(self, flat):
+        return self._v(flat, *self._bound)
+
+
+class BaseLineSearchOptimizer:
+    """One `optimize(...)` call = direction + line search on one batch
+    (reference: BaseOptimizer.optimize :182-230)."""
+
+    name = "base"
+
+    def __init__(self, max_line_search_iterations: int = 5):
+        self.line_search = BackTrackLineSearch(
+            max_iterations=max_line_search_iterations
+        )
+        self.reset()
+
+    def reset(self):
+        pass
+
+    def direction(self, g, flat):
+        raise NotImplementedError
+
+    def optimize(self, problem: _FlatProblem, flat, step0: float):
+        f0, g = problem.value_and_grad(flat)
+        f0 = float(f0)
+        d = self.direction(g, flat)
+        new_flat, f_new, step = self.line_search.search(
+            problem.value, flat, f0, g, d, step0
+        )
+        if step == 0.0:
+            # no progress along d (or non-descent) — reset memory and take a
+            # plain small gradient step (reference: step fallback)
+            self.reset()
+            new_flat = flat - step0 * g
+            f_new = float(problem.value(new_flat))
+        self._post_step(g, new_flat - flat)
+        return new_flat, f_new
+
+    def _post_step(self, g, s):
+        pass
+
+
+class LineGradientDescent(BaseLineSearchOptimizer):
+    """Steepest descent + line search (reference: LineGradientDescent.java)."""
+
+    name = "line_gradient_descent"
+
+    def direction(self, g, flat):
+        return -g
+
+
+class ConjugateGradient(BaseLineSearchOptimizer):
+    """Nonlinear CG, Polak-Ribière with automatic restart (reference:
+    ConjugateGradient.java)."""
+
+    name = "conjugate_gradient"
+
+    def reset(self):
+        self._g_prev = None
+        self._d_prev = None
+
+    def direction(self, g, flat):
+        if self._g_prev is None:
+            d = -g
+        else:
+            gg = float(jnp.vdot(self._g_prev, self._g_prev))
+            beta = max(0.0, float(jnp.vdot(g, g - self._g_prev)) / max(gg, 1e-20))
+            d = -g + beta * self._d_prev
+            if float(jnp.vdot(d, g)) >= 0:  # not a descent direction: restart
+                d = -g
+        self._g_prev = g
+        self._d_prev = d
+        return d
+
+
+class LBFGS(BaseLineSearchOptimizer):
+    """Limited-memory BFGS, two-loop recursion (reference: LBFGS.java,
+    default history m=10)."""
+
+    name = "lbfgs"
+
+    def __init__(self, m: int = 10, max_line_search_iterations: int = 5):
+        self.m = m
+        super().__init__(max_line_search_iterations)
+
+    def reset(self):
+        self._s = []  # param deltas
+        self._y = []  # gradient deltas
+        self._g_prev = None
+
+    def direction(self, g, flat):
+        if self._g_prev is not None:
+            y = g - self._g_prev
+            s = self._last_step
+            ys = float(jnp.vdot(y, s))
+            if ys > 1e-10:
+                self._s.append(s)
+                self._y.append(y)
+                if len(self._s) > self.m:
+                    self._s.pop(0)
+                    self._y.pop(0)
+        q = g
+        alphas = []
+        for s, y in zip(reversed(self._s), reversed(self._y)):
+            rho = 1.0 / float(jnp.vdot(y, s))
+            a = rho * float(jnp.vdot(s, q))
+            alphas.append((a, rho, s, y))
+            q = q - a * y
+        if self._y:
+            y_last, s_last = self._y[-1], self._s[-1]
+            gamma = float(jnp.vdot(s_last, y_last)) / float(jnp.vdot(y_last, y_last))
+            q = gamma * q
+        for a, rho, s, y in reversed(alphas):
+            b = rho * float(jnp.vdot(y, q))
+            q = q + (a - b) * s
+        self._g_prev = g
+        return -q
+
+    def _post_step(self, g, s):
+        self._last_step = s
+
+
+_OPTIMIZERS = {
+    "line_gradient_descent": LineGradientDescent,
+    "conjugate_gradient": ConjugateGradient,
+    "lbfgs": LBFGS,
+}
+
+
+def make_line_search_optimizer(algo: str) -> BaseLineSearchOptimizer:
+    cls = _OPTIMIZERS.get(algo)
+    if cls is None:
+        raise ValueError(
+            f"unknown optimization algorithm {algo!r}; known: sgd, "
+            + ", ".join(sorted(_OPTIMIZERS))
+        )
+    return cls()

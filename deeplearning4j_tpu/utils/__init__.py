@@ -1,1 +1,18 @@
-"""Subpackage."""
+"""Utilities: model serialization, FLOP accounting.
+
+Analog of the reference's deeplearning4j-nn util/ package
+(ModelSerializer, misc helpers — SURVEY.md §2.1 "Model I/O", "Misc util").
+"""
+
+from deeplearning4j_tpu.utils.model_serializer import (
+    load_model,
+    restore_computation_graph,
+    restore_multi_layer_network,
+    save_model,
+)
+from deeplearning4j_tpu.utils.flops import (
+    graph_forward_flops,
+    mln_forward_flops,
+    peak_flops_per_chip,
+    train_step_flops,
+)

@@ -1,0 +1,122 @@
+"""Analytic FLOP estimates for MFU reporting.
+
+The standard model-FLOPs accounting (as in the MFU literature): a matmul or
+conv contributes 2·MACs forward; a training step costs ≈ 3× forward (one
+forward + two matmul-shaped backward passes). Elementwise/normalization
+work is excluded — it is bandwidth-, not FLOPs-bound on TPU, and excluding
+it makes MFU comparable across frameworks.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.nn.conf.graph import (
+    ComputationGraphConfiguration,
+    LayerVertex,
+)
+from deeplearning4j_tpu.nn.conf.inputs import ConvolutionalInput, RecurrentInput
+
+
+def _layer_forward_flops(conf, it) -> float:
+    """Per-example forward FLOPs of one layer given its input type."""
+    inner = conf.inner if isinstance(conf, L.FrozenLayer) else conf
+    if isinstance(inner, L.ConvolutionLayer):
+        out = inner.output_type(it)
+        k = inner.kernel_size
+        return 2.0 * k[0] * k[1] * inner.n_in * inner.n_out * out.height * out.width
+    if isinstance(inner, L.Convolution1DLayer):
+        out = inner.output_type(it)
+        t = out.timesteps or (it.timesteps or 1)
+        return 2.0 * inner.kernel_size * inner.n_in * inner.n_out * t
+    if isinstance(inner, (L.LSTM, L.GravesLSTM, L.GravesBidirectionalLSTM)):
+        t = it.timesteps or 1
+        per_step = 2.0 * 4 * inner.n_out * (inner.n_in + inner.n_out)
+        mult = 2 if isinstance(inner, L.GravesBidirectionalLSTM) else 1
+        return per_step * t * mult
+    if isinstance(inner, L.RnnOutputLayer):
+        t = it.timesteps or 1
+        return 2.0 * inner.n_in * inner.n_out * t
+    if isinstance(inner, (L.DenseLayer, L.OutputLayer, L.CenterLossOutputLayer,
+                          L.AutoEncoder)):
+        return 2.0 * inner.n_in * inner.n_out
+    if isinstance(inner, L.EmbeddingLayer):
+        return 0.0  # gather, not matmul
+    return 0.0
+
+
+def graph_forward_flops(conf: ComputationGraphConfiguration) -> Optional[float]:
+    """Per-example forward FLOPs of a ComputationGraph, via a shape-
+    inference walk of the topo order. None if input_types are unset."""
+    if conf.input_types is None:
+        return None
+    types = dict(zip(conf.inputs, conf.input_types))
+    total = 0.0
+    for name in conf.topological_order():
+        if name in types:
+            continue
+        v = conf.vertices[name]
+        its = [types.get(i) for i in conf.vertex_inputs[name]]
+        if any(i is None for i in its):
+            types[name] = None
+            continue
+        if isinstance(v, LayerVertex):
+            it = its[0]
+            if v.preprocessor is not None:
+                it = v.preprocessor.output_type(it)
+            total += _layer_forward_flops(v.layer, it)
+            types[name] = v.layer.output_type(it)
+        else:
+            types[name] = v.output_type(its)
+    return total
+
+
+def mln_forward_flops(conf) -> Optional[float]:
+    """Per-example forward FLOPs of a MultiLayerConfiguration."""
+    if conf.input_type is None:
+        return None
+    it = conf.input_type
+    total = 0.0
+    for i, layer in enumerate(conf.layers):
+        pp = conf.preprocessors.get(str(i))
+        if pp is not None:
+            it = pp.output_type(it)
+        total += _layer_forward_flops(layer, it)
+        it = layer.output_type(it)
+    return total
+
+
+def train_step_flops(forward_flops: float, batch: int) -> float:
+    """Model FLOPs of one optimizer step: 3× forward (fwd + grad wrt
+    activations + grad wrt weights), times the batch."""
+    return 3.0 * forward_flops * batch
+
+
+# bf16 peak matmul throughput per chip, for MFU. v5e: 197 TFLOP/s.
+TPU_PEAK_FLOPS = {
+    "v5e": 197e12,
+    "v5litepod": 197e12,
+    "v4": 275e12,
+    "v5p": 459e12,
+    "v6e": 918e12,
+}
+
+
+def peak_flops_per_chip(default: float = 197e12) -> float:
+    """Best-effort peak bf16 FLOP/s of the current chip."""
+    import os
+
+    env = os.environ.get("BENCH_PEAK_FLOPS")
+    if env:
+        return float(env)
+    try:
+        import jax
+
+        kind = jax.devices()[0].device_kind.lower().replace(" ", "")
+        for key, val in TPU_PEAK_FLOPS.items():
+            if key in kind:
+                return val
+    except Exception:
+        pass
+    return default

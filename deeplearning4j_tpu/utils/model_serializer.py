@@ -1,0 +1,151 @@
+"""Model serialization — save/restore networks with updater state.
+
+Analog of the reference's util/ModelSerializer.java (:40,79-118): a zip of
+  configuration.json  — the full config DSL JSON (the compat surface)
+  coefficients.bin    — the flattened parameter vector, little-endian f32
+  updaterState.bin    — the updater state, flattened in pytree order
+plus two additions the reference keeps implicit:
+  layerState.bin      — non-trainable layer state (BN running stats)
+  meta.json           — network type tag, format version, iteration/epoch
+                        counters (so LR schedules resume correctly)
+
+The flattened parameter order is the deterministic params.py convention
+(layer/topo index, then param_order names, row-major) — the same vector
+params()/set_params() exposes, so a saved file is also the parameter-
+averaging/serving interchange format.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import zipfile
+from typing import Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+FORMAT_VERSION = 1
+
+_CONFIG_JSON = "configuration.json"
+_COEFFICIENTS = "coefficients.bin"
+_UPDATER_STATE = "updaterState.bin"
+_LAYER_STATE = "layerState.bin"
+_META = "meta.json"
+
+
+def _flatten_tree(tree) -> np.ndarray:
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return np.zeros((0,), np.float32)
+    return np.concatenate(
+        [np.asarray(l, dtype=np.float32).ravel() for l in leaves]
+    )
+
+
+def _unflatten_tree(template, vec: np.ndarray):
+    """Scatter vec into a pytree with template's structure/shapes/dtypes."""
+    leaves, treedef = jax.tree_util.tree_flatten(template)
+    out = []
+    off = 0
+    for l in leaves:
+        n = int(np.prod(np.shape(l)))
+        out.append(
+            jnp.asarray(vec[off : off + n].reshape(np.shape(l)),
+                        dtype=jnp.asarray(l).dtype)
+        )
+        off += n
+    if off != vec.size:
+        raise ValueError(
+            f"state vector length {vec.size} != expected {off} — saved file "
+            "does not match this configuration/updater"
+        )
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def save_model(net, path: Union[str, os.PathLike], save_updater: bool = True) -> None:
+    """Write a model zip (reference: ModelSerializer.writeModel :79-118)."""
+    net._require_init()
+    meta = {
+        "format_version": FORMAT_VERSION,
+        "network_type": type(net).__name__,
+        "iteration": int(net.iteration),
+        "epoch": int(net.epoch),
+        "save_updater": bool(save_updater),
+    }
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
+        zf.writestr(_CONFIG_JSON, net.conf.to_json())
+        zf.writestr(_META, json.dumps(meta, indent=2))
+        zf.writestr(
+            _COEFFICIENTS,
+            np.asarray(net.params(), dtype="<f4").tobytes(),
+        )
+        zf.writestr(_LAYER_STATE, _flatten_tree(net.state_list).astype("<f4").tobytes())
+        if save_updater:
+            zf.writestr(
+                _UPDATER_STATE,
+                _flatten_tree(net.upd_state).astype("<f4").tobytes(),
+            )
+
+
+def _read_vec(zf: zipfile.ZipFile, name: str) -> Optional[np.ndarray]:
+    try:
+        data = zf.read(name)
+    except KeyError:
+        return None
+    return np.frombuffer(data, dtype="<f4").copy()
+
+
+def load_model(path: Union[str, os.PathLike], load_updater: bool = True):
+    """Restore a network from a model zip; dispatches on the saved config
+    type (reference: restoreMultiLayerNetwork/restoreComputationGraph +
+    ModelGuesser)."""
+    from deeplearning4j_tpu.nn.compgraph import ComputationGraph
+    from deeplearning4j_tpu.nn.conf.graph import ComputationGraphConfiguration
+    from deeplearning4j_tpu.nn.conf.network import MultiLayerConfiguration
+    from deeplearning4j_tpu.nn.conf.serde import config_from_json
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    with zipfile.ZipFile(path, "r") as zf:
+        conf = config_from_json(zf.read(_CONFIG_JSON).decode("utf-8"))
+        meta = json.loads(zf.read(_META).decode("utf-8"))
+        coeffs = _read_vec(zf, _COEFFICIENTS)
+        layer_state = _read_vec(zf, _LAYER_STATE)
+        upd_vec = _read_vec(zf, _UPDATER_STATE) if load_updater else None
+
+    if isinstance(conf, MultiLayerConfiguration):
+        net = MultiLayerNetwork(conf)
+    elif isinstance(conf, ComputationGraphConfiguration):
+        net = ComputationGraph(conf)
+    else:
+        raise ValueError(f"unsupported configuration type {type(conf).__name__}")
+    net.init()
+    if coeffs is not None:
+        net.set_params(coeffs)
+    if layer_state is not None and layer_state.size:
+        net.state_list = _unflatten_tree(net.state_list, layer_state)
+    if upd_vec is not None and meta.get("save_updater", True):
+        net.upd_state = _unflatten_tree(net.upd_state, upd_vec)
+    net.iteration = int(meta.get("iteration", 0))
+    net.epoch = int(meta.get("epoch", 0))
+    return net
+
+
+def restore_multi_layer_network(path, load_updater: bool = True):
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    net = load_model(path, load_updater)
+    if not isinstance(net, MultiLayerNetwork):
+        raise ValueError(f"{path} holds a {type(net).__name__}, not a MultiLayerNetwork")
+    return net
+
+
+def restore_computation_graph(path, load_updater: bool = True):
+    from deeplearning4j_tpu.nn.compgraph import ComputationGraph
+
+    net = load_model(path, load_updater)
+    if not isinstance(net, ComputationGraph):
+        raise ValueError(f"{path} holds a {type(net).__name__}, not a ComputationGraph")
+    return net

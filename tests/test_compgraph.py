@@ -1,0 +1,328 @@
+"""ComputationGraph engine tests.
+
+Mirrors the reference's graph test coverage (SURVEY.md §4:
+deeplearning4j-core/src/test/.../nn/graph/ +
+gradientcheck/GradientCheckTestsComputationGraph.java): vertex-type
+semantics, topo order, multi-input/multi-output training, fan-out gradient
+accumulation, serde round trip, and gradient checks on small DAGs.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data.dataset import MultiDataSet
+from deeplearning4j_tpu.nn.compgraph import ComputationGraph
+from deeplearning4j_tpu.nn.conf import (
+    DenseLayer,
+    DuplicateToTimeSeriesVertex,
+    ElementWiseVertex,
+    InputType,
+    L2NormalizeVertex,
+    L2Vertex,
+    LastTimeStepVertex,
+    LSTM,
+    MergeVertex,
+    NeuralNetConfiguration,
+    OutputLayer,
+    ReshapeVertex,
+    RnnOutputLayer,
+    ScaleVertex,
+    ShiftVertex,
+    StackVertex,
+    SubsetVertex,
+    UnstackVertex,
+)
+from deeplearning4j_tpu.nn.conf.graph import ComputationGraphConfiguration
+from deeplearning4j_tpu.train.gradientcheck import check_gradients_graph
+
+
+def _gb(seed=7, lr=0.05, updater="sgd"):
+    return (
+        NeuralNetConfiguration.builder()
+        .seed(seed)
+        .updater(updater)
+        .learning_rate(lr)
+        .weight_init("xavier")
+        .graph_builder()
+    )
+
+
+def _xy(n=16, nin=8, nout=3, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, nin)).astype(np.float32)
+    y = np.zeros((n, nout), np.float32)
+    y[np.arange(n), rng.integers(0, nout, n)] = 1.0
+    return x, y
+
+
+# -- topology / build --------------------------------------------------------
+
+def test_topological_order_diamond():
+    conf = (
+        _gb()
+        .add_inputs("in")
+        .add_layer("a", DenseLayer(n_out=4, activation="tanh"), "in")
+        .add_layer("b", DenseLayer(n_out=4, activation="tanh"), "in")
+        .add_vertex("m", MergeVertex(), "a", "b")
+        .add_layer("out", OutputLayer(n_out=2, activation="softmax"), "m")
+        .set_outputs("out")
+        .set_input_types(InputType.feed_forward(8))
+        .build()
+    )
+    order = conf.topological_order()
+    assert order.index("in") < order.index("a")
+    assert order.index("a") < order.index("m")
+    assert order.index("b") < order.index("m")
+    assert order.index("m") < order.index("out")
+    # shape inference wired n_in through the merge
+    assert conf.vertices["out"].layer.n_in == 8
+
+
+def test_unknown_input_rejected():
+    with pytest.raises(ValueError, match="unknown input"):
+        _gb().add_inputs("in").add_layer(
+            "a", DenseLayer(n_out=4), "nonexistent"
+        )
+
+
+def test_cycle_impossible_by_construction():
+    # vertices may only reference already-added names, so cycles can't be
+    # expressed through the builder — the config-level check still guards
+    # hand-built configs
+    conf = ComputationGraphConfiguration(
+        inputs=["in"],
+        outputs=["a"],
+        vertices={"a": None, "b": None},
+        vertex_inputs={"a": ["b"], "b": ["a"]},
+    )
+    with pytest.raises(ValueError, match="unreachable or cyclic"):
+        conf.topological_order()
+
+
+def test_serde_round_trip():
+    conf = (
+        _gb()
+        .add_inputs("in")
+        .add_layer("a", DenseLayer(n_out=4, activation="tanh"), "in")
+        .add_vertex("s", ScaleVertex(scale=0.5), "a")
+        .add_layer("out", OutputLayer(n_out=2, activation="softmax"), "s")
+        .set_outputs("out")
+        .set_input_types(InputType.feed_forward(6))
+        .build()
+    )
+    conf2 = ComputationGraphConfiguration.from_json(conf.to_json())
+    assert conf2.vertex_inputs == conf.vertex_inputs
+    assert conf2.vertices["s"].scale == 0.5
+    assert conf2.vertices["out"].layer.n_in == 4
+    # the rebuilt conf drives an identical network
+    net1 = ComputationGraph(conf).init()
+    net2 = ComputationGraph(conf2).init()
+    x, _ = _xy(4, 6, 2)
+    np.testing.assert_allclose(
+        np.asarray(net1.output(x)), np.asarray(net2.output(x)), rtol=1e-6
+    )
+
+
+# -- vertex semantics --------------------------------------------------------
+
+def test_vertex_forwards():
+    x = jnp.asarray(np.arange(12, dtype=np.float32).reshape(2, 6))
+    y = jnp.asarray(np.ones((2, 6), np.float32))
+    env = {}
+    assert MergeVertex().forward([x, y], env).shape == (2, 12)
+    np.testing.assert_allclose(
+        ElementWiseVertex(op="add").forward([x, y], env), np.asarray(x) + 1
+    )
+    np.testing.assert_allclose(
+        ElementWiseVertex(op="subtract").forward([x, y], env), np.asarray(x) - 1
+    )
+    np.testing.assert_allclose(
+        ElementWiseVertex(op="product").forward([x, y], env), np.asarray(x)
+    )
+    np.testing.assert_allclose(
+        ElementWiseVertex(op="average").forward([x, y], env),
+        (np.asarray(x) + 1) / 2,
+    )
+    np.testing.assert_allclose(
+        ElementWiseVertex(op="max").forward([x, y], env),
+        np.maximum(np.asarray(x), 1),
+    )
+    np.testing.assert_allclose(
+        SubsetVertex(from_=1, to=3).forward([x], env), np.asarray(x)[:, 1:4]
+    )
+    st = StackVertex().forward([x, y], env)
+    assert st.shape == (4, 6)
+    np.testing.assert_allclose(
+        UnstackVertex(from_=1, stack_size=2).forward([st], env), np.asarray(y)
+    )
+    np.testing.assert_allclose(
+        ScaleVertex(scale=2.0).forward([x], env), 2 * np.asarray(x)
+    )
+    np.testing.assert_allclose(
+        ShiftVertex(shift=1.5).forward([x], env), np.asarray(x) + 1.5
+    )
+    assert ReshapeVertex(new_shape=(2, 3)).forward([x], env).shape == (2, 2, 3)
+    d = L2Vertex().forward([x, y], env)
+    assert d.shape == (2, 1)
+    expected = np.sqrt(np.sum((np.asarray(x) - 1) ** 2, axis=1) + 1e-8)
+    np.testing.assert_allclose(np.asarray(d)[:, 0], expected, rtol=1e-5)
+    nz = L2NormalizeVertex().forward([x], env)
+    norms = np.linalg.norm(np.asarray(nz), axis=1)
+    np.testing.assert_allclose(norms, 1.0, rtol=1e-4)
+
+
+def test_rnn_vertices():
+    xt = jnp.asarray(np.random.default_rng(0).standard_normal((2, 5, 3)).astype(np.float32))
+    xf = jnp.asarray(np.ones((2, 3), np.float32))
+    env = {"activations": {"seq": xt}, "input_masks": {}}
+    last = LastTimeStepVertex().forward([xt], env)
+    np.testing.assert_allclose(last, np.asarray(xt)[:, -1])
+    # masked: example 0 has 3 valid steps, example 1 has 5
+    mask = jnp.asarray(np.array([[1, 1, 1, 0, 0], [1, 1, 1, 1, 1]], np.float32))
+    env_m = {"activations": {"seq": xt}, "input_masks": {"in": mask}}
+    last_m = LastTimeStepVertex(mask_input="in").forward([xt], env_m)
+    np.testing.assert_allclose(last_m[0], np.asarray(xt)[0, 2])
+    np.testing.assert_allclose(last_m[1], np.asarray(xt)[1, 4])
+    dup = DuplicateToTimeSeriesVertex(ref_input="seq").forward([xf], env)
+    assert dup.shape == (2, 5, 3)
+    np.testing.assert_allclose(dup[:, 2], np.asarray(xf))
+
+
+# -- training ----------------------------------------------------------------
+
+def test_fanout_gradient_accumulation():
+    """A vertex consumed by two branches must receive the SUM of both
+    branch gradients (reference: ComputationGraph.java:1480-1502 epsilon
+    accumulation) — checked against finite differences."""
+    conf = (
+        _gb()
+        .add_inputs("in")
+        .add_layer("shared", DenseLayer(n_out=5, activation="tanh"), "in")
+        .add_layer("b1", DenseLayer(n_out=5, activation="sigmoid"), "shared")
+        .add_layer("b2", DenseLayer(n_out=5, activation="tanh"), "shared")
+        .add_vertex("add", ElementWiseVertex(op="add"), "b1", "b2")
+        .add_layer("out", OutputLayer(n_out=3, activation="softmax"), "add")
+        .set_outputs("out")
+        .set_input_types(InputType.feed_forward(4))
+        .build()
+    )
+    net = ComputationGraph(conf).init()
+    x, y = _xy(8, 4, 3)
+    assert check_gradients_graph(net, [x], [y], max_checks=60)
+
+
+def test_multi_input_multi_output_training():
+    conf = (
+        _gb(updater="adam", lr=0.01)
+        .add_inputs("inA", "inB")
+        .add_layer("dA", DenseLayer(n_out=8, activation="relu"), "inA")
+        .add_layer("dB", DenseLayer(n_out=8, activation="relu"), "inB")
+        .add_vertex("m", MergeVertex(), "dA", "dB")
+        .add_layer("trunk", DenseLayer(n_out=8, activation="tanh"), "m")
+        .add_layer("out1", OutputLayer(n_out=3, activation="softmax"), "trunk")
+        .add_layer("out2", OutputLayer(n_out=2, activation="softmax"), "trunk")
+        .set_outputs("out1", "out2")
+        .set_input_types(InputType.feed_forward(6), InputType.feed_forward(4))
+        .build()
+    )
+    net = ComputationGraph(conf).init()
+    rng = np.random.default_rng(3)
+    xa = rng.standard_normal((32, 6)).astype(np.float32)
+    xb = rng.standard_normal((32, 4)).astype(np.float32)
+    y1 = np.zeros((32, 3), np.float32)
+    y1[np.arange(32), rng.integers(0, 3, 32)] = 1.0
+    y2 = np.zeros((32, 2), np.float32)
+    y2[np.arange(32), rng.integers(0, 2, 32)] = 1.0
+    mds = MultiDataSet([xa, xb], [y1, y2])
+    s0 = net.score(mds)
+    net.fit(mds, epochs=40, batch_size=32, async_prefetch=False)
+    s1 = net.score(mds)
+    assert s1 < s0 * 0.5
+    o1, o2 = net.output(xa, xb)
+    assert o1.shape == (32, 3) and o2.shape == (32, 2)
+
+
+def test_seq2vec_with_rnn_vertices():
+    """LSTM encoder -> LastTimeStep -> classifier, with masking — the
+    reference's rnn-vertex pattern (LastTimeStepVertex.java)."""
+    conf = (
+        _gb(updater="adam", lr=0.02)
+        .add_inputs("seq")
+        .add_layer("lstm", LSTM(n_out=8, activation="tanh"), "seq")
+        .add_vertex("last", LastTimeStepVertex(mask_input="seq"), "lstm")
+        .add_layer("out", OutputLayer(n_out=2, activation="softmax"), "last")
+        .set_outputs("out")
+        .set_input_types(InputType.recurrent(4))
+        .build()
+    )
+    net = ComputationGraph(conf).init()
+    rng = np.random.default_rng(5)
+    x = rng.standard_normal((16, 6, 4)).astype(np.float32)
+    y = np.zeros((16, 2), np.float32)
+    y[np.arange(16), rng.integers(0, 2, 16)] = 1.0
+    mask = np.ones((16, 6), np.float32)
+    mask[:8, 4:] = 0.0
+    mds = MultiDataSet([x], [y], [mask], None)
+    s0 = net.score(mds)
+    net.fit(mds, epochs=30, batch_size=16, async_prefetch=False)
+    assert net.score(mds) < s0
+
+
+def test_gradcheck_merge_subset_scale():
+    conf = (
+        _gb()
+        .add_inputs("in")
+        .add_layer("a", DenseLayer(n_out=4, activation="tanh"), "in")
+        .add_layer("b", DenseLayer(n_out=6, activation="sigmoid"), "in")
+        .add_vertex("m", MergeVertex(), "a", "b")
+        .add_vertex("sub", SubsetVertex(from_=2, to=7), "m")
+        .add_vertex("sc", ScaleVertex(scale=1.5), "sub")
+        .add_layer("out", OutputLayer(n_out=3, activation="softmax"), "sc")
+        .set_outputs("out")
+        .set_input_types(InputType.feed_forward(5))
+        .build()
+    )
+    net = ComputationGraph(conf).init()
+    x, y = _xy(6, 5, 3, seed=2)
+    assert check_gradients_graph(net, [x], [y], max_checks=60)
+
+
+def test_l2_vertices_gradcheck():
+    conf = (
+        _gb()
+        .add_inputs("a", "b")
+        .add_layer("ea", DenseLayer(n_out=6, activation="tanh"), "a")
+        .add_layer("eb", DenseLayer(n_out=6, activation="tanh"), "b")
+        .add_vertex("na", L2NormalizeVertex(), "ea")
+        .add_vertex("nb", L2NormalizeVertex(), "eb")
+        .add_vertex("dist", L2Vertex(), "na", "nb")
+        .add_layer("out", OutputLayer(n_out=2, activation="softmax"), "dist")
+        .set_outputs("out")
+        .set_input_types(InputType.feed_forward(4), InputType.feed_forward(4))
+        .build()
+    )
+    net = ComputationGraph(conf).init()
+    rng = np.random.default_rng(4)
+    xa = rng.standard_normal((6, 4)).astype(np.float32)
+    xb = rng.standard_normal((6, 4)).astype(np.float32)
+    y = np.zeros((6, 2), np.float32)
+    y[np.arange(6), rng.integers(0, 2, 6)] = 1.0
+    assert check_gradients_graph(net, [xa, xb], [y], max_checks=50)
+
+
+def test_evaluate_single_output():
+    conf = (
+        _gb(updater="adam", lr=0.05)
+        .add_inputs("in")
+        .add_layer("d", DenseLayer(n_out=16, activation="relu"), "in")
+        .add_layer("out", OutputLayer(n_out=3, activation="softmax"), "d")
+        .set_outputs("out")
+        .set_input_types(InputType.feed_forward(8))
+        .build()
+    )
+    net = ComputationGraph(conf).init()
+    x, y = _xy(64, 8, 3)
+    net.fit(x, y, epochs=60, batch_size=32, async_prefetch=False)
+    ev = net.evaluate(x, y)
+    assert ev.accuracy() > 0.8

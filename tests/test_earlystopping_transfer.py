@@ -1,0 +1,260 @@
+"""Early stopping + transfer learning tests (reference patterns:
+earlystopping/trainer/BaseEarlyStoppingTrainer tests and
+TransferLearning builder tests in deeplearning4j-core)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.nn.conf import (
+    DenseLayer,
+    NeuralNetConfiguration,
+    OutputLayer,
+    Updater,
+)
+from deeplearning4j_tpu.nn.conf.layers import FrozenLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.transferlearning import (
+    TransferLearning,
+    TransferLearningHelper,
+)
+from deeplearning4j_tpu.train.earlystopping import (
+    DataSetLossCalculator,
+    EarlyStoppingConfiguration,
+    EarlyStoppingTrainer,
+    InMemoryModelSaver,
+    InvalidScoreIterationTerminationCondition,
+    LocalFileModelSaver,
+    MaxEpochsTerminationCondition,
+    MaxScoreIterationTerminationCondition,
+    MaxTimeIterationTerminationCondition,
+    ScoreImprovementEpochTerminationCondition,
+    TerminationReason,
+)
+
+
+def _net(lr=0.05, seed=7):
+    return MultiLayerNetwork(
+        NeuralNetConfiguration.builder()
+        .seed(seed)
+        .updater(Updater.ADAM)
+        .learning_rate(lr)
+        .weight_init("xavier")
+        .list()
+        .layer(DenseLayer(n_in=8, n_out=16, activation="relu"))
+        .layer(DenseLayer(n_in=16, n_out=12, activation="tanh"))
+        .layer(OutputLayer(n_in=12, n_out=3, activation="softmax", loss="mcxent"))
+        .build()
+    ).init()
+
+
+def _xy(n=64, nin=8, nout=3, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, nin)).astype(np.float32)
+    y = np.zeros((n, nout), np.float32)
+    y[np.arange(n), rng.integers(0, nout, n)] = 1.0
+    return x, y
+
+
+# -- early stopping ----------------------------------------------------------
+
+def test_max_epochs_condition():
+    x, y = _xy()
+    cfg = EarlyStoppingConfiguration(
+        score_calculator=DataSetLossCalculator(DataSet(x, y)),
+        epoch_termination_conditions=[MaxEpochsTerminationCondition(5)],
+    )
+    result = EarlyStoppingTrainer(cfg, _net(), x, y, batch_size=32).fit()
+    assert result.termination_reason == TerminationReason.EPOCH_CONDITION
+    assert "MaxEpochs" in result.termination_details
+    assert result.total_epochs == 5
+    assert result.best_model is not None
+    assert np.isfinite(result.best_model_score)
+
+
+def test_score_improvement_patience():
+    """With an absurd min_improvement, patience triggers quickly."""
+    x, y = _xy()
+    cfg = EarlyStoppingConfiguration(
+        score_calculator=DataSetLossCalculator(DataSet(x, y)),
+        epoch_termination_conditions=[
+            ScoreImprovementEpochTerminationCondition(2, min_improvement=100.0),
+            MaxEpochsTerminationCondition(50),
+        ],
+    )
+    result = EarlyStoppingTrainer(cfg, _net(), x, y, batch_size=32).fit()
+    assert "ScoreImprovement" in result.termination_details
+    assert result.total_epochs <= 5
+
+
+def test_max_score_iteration_condition_aborts():
+    """A divergence bound below the initial loss aborts inside epoch 0."""
+    x, y = _xy()
+    cfg = EarlyStoppingConfiguration(
+        score_calculator=DataSetLossCalculator(DataSet(x, y)),
+        epoch_termination_conditions=[MaxEpochsTerminationCondition(10)],
+        iteration_termination_conditions=[
+            MaxScoreIterationTerminationCondition(1e-9),
+        ],
+    )
+    result = EarlyStoppingTrainer(cfg, _net(), x, y, batch_size=32).fit()
+    assert result.termination_reason == TerminationReason.ITERATION_CONDITION
+    assert "MaxScore" in result.termination_details
+
+
+def test_max_time_condition_aborts():
+    x, y = _xy()
+    cfg = EarlyStoppingConfiguration(
+        score_calculator=DataSetLossCalculator(DataSet(x, y)),
+        epoch_termination_conditions=[MaxEpochsTerminationCondition(10_000)],
+        iteration_termination_conditions=[
+            MaxTimeIterationTerminationCondition(0.0),
+        ],
+    )
+    result = EarlyStoppingTrainer(cfg, _net(), x, y, batch_size=32).fit()
+    assert result.termination_reason == TerminationReason.ITERATION_CONDITION
+
+
+def test_best_model_tracked_and_usable():
+    x, y = _xy()
+    cfg = EarlyStoppingConfiguration(
+        score_calculator=DataSetLossCalculator(DataSet(x, y)),
+        epoch_termination_conditions=[MaxEpochsTerminationCondition(8)],
+        model_saver=InMemoryModelSaver(),
+    )
+    result = EarlyStoppingTrainer(cfg, _net(), x, y, batch_size=32).fit()
+    best = result.best_model
+    assert best.score(x, y) == pytest.approx(result.best_model_score, rel=1e-4)
+    assert min(result.score_vs_epoch.values()) == result.best_model_score
+
+
+def test_local_file_saver(tmp_path):
+    x, y = _xy()
+    cfg = EarlyStoppingConfiguration(
+        score_calculator=DataSetLossCalculator(DataSet(x, y)),
+        epoch_termination_conditions=[MaxEpochsTerminationCondition(3)],
+        model_saver=LocalFileModelSaver(str(tmp_path)),
+        save_last_model=True,
+    )
+    result = EarlyStoppingTrainer(cfg, _net(), x, y, batch_size=32).fit()
+    assert (tmp_path / "bestModel.zip").exists()
+    assert (tmp_path / "latestModel.zip").exists()
+    loaded = cfg.model_saver.get_best_model()
+    assert loaded.score(x, y) == pytest.approx(result.best_model_score, rel=1e-4)
+
+
+def test_invalid_score_condition():
+    c = InvalidScoreIterationTerminationCondition()
+    assert c.terminate(0, float("nan"))
+    assert c.terminate(0, float("inf"))
+    assert not c.terminate(0, 1.0)
+
+
+# -- transfer learning -------------------------------------------------------
+
+def test_set_feature_extractor_freezes():
+    x, y = _xy()
+    src = _net()
+    src.fit(x, y, epochs=2, batch_size=32, async_prefetch=False)
+    new = (
+        TransferLearning.Builder(src)
+        .set_feature_extractor(1)
+        .build()
+    )
+    assert isinstance(new.layer_confs[0], FrozenLayer)
+    assert isinstance(new.layer_confs[1], FrozenLayer)
+    assert not isinstance(new.layer_confs[2], FrozenLayer)
+    frozen_before = [np.asarray(p["W"]).copy() for p in new.params_list[:2]]
+    head_before = np.asarray(new.params_list[2]["W"]).copy()
+    new.fit(x, y, epochs=3, batch_size=32, async_prefetch=False)
+    for before, p in zip(frozen_before, new.params_list[:2]):
+        np.testing.assert_array_equal(before, np.asarray(p["W"]))
+    assert np.abs(head_before - np.asarray(new.params_list[2]["W"])).max() > 0
+    # source network untouched (functional builder)
+    assert not isinstance(src.layer_confs[0], FrozenLayer)
+
+
+def test_n_out_replace_rewires_and_transfers():
+    x, y = _xy()
+    src = _net()
+    src.fit(x, y, epochs=2, batch_size=32, async_prefetch=False)
+    new = (
+        TransferLearning.Builder(src)
+        .n_out_replace(1, 20, weight_init="xavier")
+        .build()
+    )
+    assert new.layer_confs[1].n_out == 20
+    assert new.layer_confs[2].n_in == 20
+    assert new.params_list[1]["W"].shape == (16, 20)
+    assert new.params_list[2]["W"].shape == (20, 3)
+    # untouched layer 0 shares the trained weights
+    np.testing.assert_array_equal(
+        np.asarray(src.params_list[0]["W"]), np.asarray(new.params_list[0]["W"])
+    )
+    new.fit(x, y, epochs=1, batch_size=32, async_prefetch=False)
+
+
+def test_remove_and_add_output_layer():
+    src = _net()
+    new = (
+        TransferLearning.Builder(src)
+        .remove_output_layer()
+        .add_layer(DenseLayer(n_out=10, activation="relu"))
+        .add_layer(OutputLayer(n_out=5, activation="softmax", loss="mcxent"))
+        .build()
+    )
+    assert len(new.layer_confs) == 4
+    assert new.layer_confs[2].n_in == 12  # wired from previous layer
+    assert new.layer_confs[3].n_in == 10
+    x, _ = _xy()
+    assert new.output(x).shape == (64, 5)
+
+
+def test_fine_tune_configuration_overrides():
+    src = _net(lr=0.05)
+    new = (
+        TransferLearning.Builder(src)
+        .fine_tune_configuration(learning_rate=0.001, updater="sgd")
+        .build()
+    )
+    assert new.net_conf.learning_rate == 0.001
+    assert new.net_conf.updater == "sgd"
+    with pytest.raises(ValueError, match="unknown fine-tune"):
+        TransferLearning.Builder(src).fine_tune_configuration(bogus=1).build()
+
+
+def test_freeze_then_finetune_accuracy():
+    """The reference's canonical flow: pretrain on task A, freeze the
+    trunk, fine-tune a new head on task B — accuracy on B improves."""
+    xa, ya = _xy(128, seed=1)
+    src = _net()
+    src.fit(xa, ya, epochs=8, batch_size=32, async_prefetch=False)
+
+    xb, yb = _xy(128, nout=3, seed=99)
+    new = (
+        TransferLearning.Builder(src)
+        .set_feature_extractor(1)
+        .n_out_replace(2, 3, weight_init="xavier")
+        .fine_tune_configuration(learning_rate=0.01)
+        .build()
+    )
+    acc0 = new.evaluate(xb, yb).accuracy()
+    new.fit(xb, yb, epochs=25, batch_size=32, async_prefetch=False)
+    acc1 = new.evaluate(xb, yb).accuracy()
+    assert acc1 > acc0
+
+
+def test_transfer_learning_helper_featurize():
+    x, y = _xy(64)
+    src = _net()
+    src.fit(x, y, epochs=2, batch_size=32, async_prefetch=False)
+    frozen = TransferLearning.Builder(src).set_feature_extractor(1).build()
+    helper = TransferLearningHelper(frozen)
+    feat = helper.featurize(DataSet(x, y))
+    assert feat.features.shape == (64, 12)  # output of layer 1
+    # training on featurized data == training the tail; outputs must match
+    # the full network's on the same params
+    helper.fit_featurized(feat.features, feat.labels, epochs=3, batch_size=32)
+    full_out = np.asarray(frozen.output(x))
+    tail_out = np.asarray(helper.unfrozen_network().output(feat.features))
+    np.testing.assert_allclose(full_out, tail_out, rtol=1e-5, atol=1e-6)

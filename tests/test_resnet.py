@@ -1,0 +1,63 @@
+"""ResNet workload tests (tiny variants on CPU; the full ResNet-50 is the
+bench workload — here we verify its construction and parameter count
+against the canonical 25.5M)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.compgraph import ComputationGraph
+from deeplearning4j_tpu.models.resnet import (
+    resnet50_conf,
+    tiny_resnet_conf,
+)
+from deeplearning4j_tpu.train.gradientcheck import check_gradients_graph
+
+
+def _tiny_net():
+    return ComputationGraph(tiny_resnet_conf()).init()
+
+
+def _img_batch(n=8, size=8, classes=3, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, size, size, 3)).astype(np.float32)
+    y = np.zeros((n, classes), np.float32)
+    y[np.arange(n), rng.integers(0, classes, n)] = 1.0
+    return x, y
+
+
+def test_resnet50_builds_with_canonical_param_count():
+    conf = resnet50_conf(num_classes=1000, image_size=224)
+    net = ComputationGraph(conf)
+    # count params without materializing arrays: conv k*k*cin*cout, bn 2c,
+    # dense (nin+1)*nout — init on CPU is fast enough to just do it
+    net.init()
+    total = net.num_params()
+    # torchvision resnet50: 25,557,032 params (incl. BN). Ours counts W+b
+    # for the head and gamma/beta for BN the same way.
+    assert total == 25_557_032, f"got {total}"
+
+
+def test_tiny_resnet_trains():
+    net = _tiny_net()
+    x, y = _img_batch(16)
+    s0 = net.score(x, y)
+    net.fit(x, y, epochs=25, batch_size=16, async_prefetch=False)
+    s1 = net.score(x, y)
+    assert s1 < s0, (s0, s1)
+
+
+def test_tiny_resnet_gradcheck():
+    """Gradient check through conv/BN/residual-add/global-pool DAG
+    (reference: CNNGradientCheckTest + GradientCheckTestsComputationGraph)."""
+    net = _tiny_net()
+    x, y = _img_batch(4)
+    assert check_gradients_graph(net, [x], [y], max_checks=80)
+
+
+def test_tiny_resnet_inference_shapes():
+    net = _tiny_net()
+    x, _ = _img_batch(5)
+    out = net.output(x)
+    assert out.shape == (5, 3)
+    probs = np.asarray(out)
+    np.testing.assert_allclose(probs.sum(axis=1), 1.0, rtol=1e-4)

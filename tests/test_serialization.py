@@ -1,0 +1,172 @@
+"""Model serialization tests.
+
+Mirrors the reference's serialization strategy (SURVEY.md §4): save/load
+round trip for both network types, updater-state preservation
+(resume-training continuity), and a committed golden file guarding the
+format across versions (reference: regressiontest/RegressionTest*.java
+loading zips produced by past releases)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.compgraph import ComputationGraph
+from deeplearning4j_tpu.nn.conf import (
+    BatchNormalization,
+    DenseLayer,
+    InputType,
+    LSTM,
+    MergeVertex,
+    NeuralNetConfiguration,
+    OutputLayer,
+    RnnOutputLayer,
+    Updater,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.utils import (
+    load_model,
+    restore_computation_graph,
+    restore_multi_layer_network,
+    save_model,
+)
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+
+
+def _mln(updater=Updater.ADAM, seed=11):
+    return MultiLayerNetwork(
+        NeuralNetConfiguration.builder()
+        .seed(seed)
+        .updater(updater)
+        .learning_rate(0.02)
+        .weight_init("xavier")
+        .list()
+        .layer(DenseLayer(n_in=6, n_out=12, activation="tanh"))
+        .layer(BatchNormalization(n_in=12))
+        .layer(OutputLayer(n_in=12, n_out=3, activation="softmax", loss="mcxent"))
+        .build()
+    ).init()
+
+
+def _cg(seed=13):
+    return ComputationGraph(
+        NeuralNetConfiguration.builder()
+        .seed(seed)
+        .updater(Updater.NESTEROVS)
+        .learning_rate(0.05)
+        .weight_init("xavier")
+        .graph_builder()
+        .add_inputs("in")
+        .add_layer("a", DenseLayer(n_out=8, activation="relu"), "in")
+        .add_layer("b", DenseLayer(n_out=8, activation="tanh"), "in")
+        .add_vertex("m", MergeVertex(), "a", "b")
+        .add_layer("out", OutputLayer(n_out=3, activation="softmax"), "m")
+        .set_outputs("out")
+        .set_input_types(InputType.feed_forward(6))
+        .build()
+    ).init()
+
+
+def _xy(n=32, nin=6, nout=3, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, nin)).astype(np.float32)
+    y = np.zeros((n, nout), np.float32)
+    y[np.arange(n), rng.integers(0, nout, n)] = 1.0
+    return x, y
+
+
+def test_mln_save_load_round_trip(tmp_path):
+    net = _mln()
+    x, y = _xy()
+    net.fit(x, y, epochs=2, batch_size=16, async_prefetch=False)
+    p = tmp_path / "model.zip"
+    save_model(net, p)
+    net2 = restore_multi_layer_network(p)
+    np.testing.assert_allclose(
+        np.asarray(net.output(x)), np.asarray(net2.output(x)), rtol=1e-6
+    )
+    # counters restored (LR schedules resume at the right iteration)
+    assert net2.iteration == net.iteration
+    assert net2.epoch == net.epoch
+    # BN running stats restored
+    for s1, s2 in zip(net.state_list, net2.state_list):
+        if s1 is None:
+            assert s2 is None
+            continue
+        for k in s1:
+            np.testing.assert_allclose(np.asarray(s1[k]), np.asarray(s2[k]), rtol=1e-6)
+
+
+def test_cg_save_load_round_trip(tmp_path):
+    net = _cg()
+    x, y = _xy()
+    net.fit(x, y, epochs=2, batch_size=16, async_prefetch=False)
+    p = tmp_path / "graph.zip"
+    save_model(net, p)
+    net2 = restore_computation_graph(p)
+    np.testing.assert_allclose(
+        np.asarray(net.output(x)), np.asarray(net2.output(x)), rtol=1e-6
+    )
+
+
+def test_resume_training_continuity(tmp_path):
+    """train k steps -> save -> load -> train k more == train 2k straight
+    (updater momentum preserved; reference: updaterState.bin round trip)."""
+    x, y = _xy(64)
+    straight = _mln()
+    straight.fit(x, y, epochs=4, batch_size=16, async_prefetch=False)
+
+    resumed = _mln()
+    resumed.fit(x, y, epochs=2, batch_size=16, async_prefetch=False)
+    p = tmp_path / "ckpt.zip"
+    save_model(resumed, p)
+    resumed2 = restore_multi_layer_network(p)
+    resumed2.fit(x, y, epochs=2, batch_size=16, async_prefetch=False)
+
+    for p1, p2 in zip(straight.params_list, resumed2.params_list):
+        for k in p1:
+            np.testing.assert_allclose(
+                np.asarray(p1[k]), np.asarray(p2[k]), rtol=1e-5, atol=1e-6
+            )
+
+
+def test_resume_without_updater_differs(tmp_path):
+    """load_updater=False resets momentum — sanity check that the updater
+    state actually matters (guards against silently-empty updaterState)."""
+    x, y = _xy(64)
+    net = _mln(updater=Updater.NESTEROVS)
+    net.fit(x, y, epochs=2, batch_size=16, async_prefetch=False)
+    p = tmp_path / "ckpt.zip"
+    save_model(net, p)
+    with_upd = restore_multi_layer_network(p, load_updater=True)
+    without = restore_multi_layer_network(p, load_updater=False)
+    with_upd.fit(x, y, epochs=1, batch_size=16, async_prefetch=False)
+    without.fit(x, y, epochs=1, batch_size=16, async_prefetch=False)
+    diffs = [
+        np.max(np.abs(np.asarray(a[k]) - np.asarray(b[k])))
+        for a, b in zip(with_upd.params_list, without.params_list)
+        for k in a
+    ]
+    assert max(diffs) > 1e-7
+
+
+def test_wrong_type_restore_raises(tmp_path):
+    net = _mln()
+    p = tmp_path / "m.zip"
+    save_model(net, p)
+    with pytest.raises(ValueError, match="not a ComputationGraph"):
+        restore_computation_graph(p)
+
+
+def test_golden_file_regression():
+    """Load the committed fixture and assert exact expected outputs —
+    the cross-version format contract (reference:
+    regressiontest/RegressionTest080.java)."""
+    path = os.path.join(FIXTURES, "mln_adam_v1.zip")
+    expected = np.load(os.path.join(FIXTURES, "mln_adam_v1_expected.npz"))
+    net = load_model(path)
+    x = expected["x"]
+    out = np.asarray(net.output(x))
+    np.testing.assert_allclose(out, expected["out"], rtol=1e-5, atol=1e-6)
+    assert net.iteration == int(expected["iteration"])
