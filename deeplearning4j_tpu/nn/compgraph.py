@@ -340,24 +340,39 @@ class ComputationGraph(NetworkBase):
 
     # -- inference -----------------------------------------------------------
 
-    def output(self, *inputs):
+    def output(self, *inputs, input_masks: Optional[Sequence] = None):
         """Forward pass; returns one array for a single-output graph, else
-        a list in set_outputs order (reference: ComputationGraph.output)."""
+        a list in set_outputs order (reference: ComputationGraph.output,
+        incl. the output(INDArray[], masks) overloads — input_masks aligns
+        with the graph's inputs and feeds mask-aware vertices such as
+        LastTimeStepVertex)."""
         self._require_init()
         if self._output_fn is None:
-            def fwd(params, states, xs):
+            def fwd(params, states, xs, masks):
                 xs = [self.policy.cast_input(x) for x in xs]
                 acts, _ = self._forward(
-                    params, states, xs, training=False, rng=None
+                    params, states, xs, training=False, rng=None,
+                    input_masks=masks,
                 )
                 return [
                     self.policy.cast_output(acts[n]) for n in self.conf.outputs
                 ]
 
             self._output_fn = jax.jit(fwd)
+        masks = None
+        if input_masks is not None:
+            if len(input_masks) != len(self.conf.inputs):
+                raise ValueError(
+                    f"input_masks has {len(input_masks)} entries but the "
+                    f"graph has {len(self.conf.inputs)} inputs "
+                    f"({self.conf.inputs}); pass one mask (or None) per input"
+                )
+            masks = [
+                None if m is None else jnp.asarray(m) for m in input_masks
+            ]
         outs = self._output_fn(
             self.params_list, self.state_list,
-            [jnp.asarray(x) for x in inputs],
+            [jnp.asarray(x) for x in inputs], masks,
         )
         return outs[0] if len(outs) == 1 else outs
 
@@ -401,7 +416,9 @@ class ComputationGraph(NetworkBase):
             batches = DataSet(np.asarray(data), np.asarray(labels)).split_batches(batch_size)
         for b in batches:
             mds = _as_multidataset(b)
-            out = self.output(*mds.features)
+            out = self.output(*mds.features, input_masks=mds.features_masks)
+            if isinstance(out, list):
+                out = out[0]
             lm = None if mds.labels_masks is None else mds.labels_masks[0]
             ev.eval_batch(mds.labels[0], out, lm)
         return ev
@@ -418,6 +435,9 @@ class ComputationGraph(NetworkBase):
             other.state_list = [
                 None if s is None else dict(s) for s in self.state_list
             ]
+            other.upd_state = jax.tree_util.tree_map(lambda a: a, self.upd_state)
+            other.iteration = self.iteration
+            other.epoch = self.epoch
         return other
 
 

@@ -403,6 +403,19 @@ class GraphBuilder:
         if not inputs:
             raise ValueError(f"layer {name!r} needs at least one input")
         self._check_new(name, inputs)
+        if len(inputs) > 1:
+            # a layer consumes exactly one activation: auto-insert a
+            # MergeVertex over multiple inputs, as the reference does
+            # (ComputationGraphConfiguration.java:580-584)
+            merge_name = f"{name}-merge"
+            if merge_name in self._vertices or merge_name in self._inputs:
+                raise ValueError(
+                    f"cannot auto-insert merge vertex {merge_name!r}: name "
+                    "already taken"
+                )
+            self._vertices[merge_name] = MergeVertex()
+            self._vertex_inputs[merge_name] = list(inputs)
+            inputs = (merge_name,)
         self._vertices[name] = LayerVertex(layer=layer, preprocessor=preprocessor)
         self._vertex_inputs[name] = list(inputs)
         return self

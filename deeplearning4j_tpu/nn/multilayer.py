@@ -713,4 +713,11 @@ class MultiLayerNetwork(NetworkBase):
             other.state_list = [
                 None if s is None else dict(s) for s in self.state_list
             ]
+            # the clone resumes training equivalently: updater state
+            # (momentum/Adam moments) + counters (LR schedule position)
+            # travel with it (reference: MultiLayerNetwork.clone carries
+            # the updater)
+            other.upd_state = jax.tree_util.tree_map(lambda a: a, self.upd_state)
+            other.iteration = self.iteration
+            other.epoch = self.epoch
         return other

@@ -21,6 +21,11 @@ import numpy as np
 # -- termination conditions --------------------------------------------------
 
 class EpochTerminationCondition:
+    # conditions that read the score are only checked on epochs where a
+    # fresh score was computed; score-free conditions (MaxEpochs, custom
+    # wall-clock subclasses) run every epoch
+    requires_score = True
+
     def initialize(self):
         pass
 
@@ -38,6 +43,8 @@ class IterationTerminationCondition:
 
 class MaxEpochsTerminationCondition(EpochTerminationCondition):
     """Stop after N epochs (reference: MaxEpochsTerminationCondition)."""
+
+    requires_score = False
 
     def __init__(self, max_epochs: int):
         self.max_epochs = int(max_epochs)
@@ -83,7 +90,7 @@ class ScoreImprovementEpochTerminationCondition(EpochTerminationCondition):
             self._since = 0
             return False
         self._since += 1
-        return self._since > self.patience
+        return self._since >= self.patience
 
     def __repr__(self):
         return (f"ScoreImprovementEpochTerminationCondition("
@@ -314,24 +321,32 @@ class EarlyStoppingTrainer:
                     reason = TerminationReason.ITERATION_CONDITION
                     details = repr(stop.condition)
                     break
+                last_score = None
                 if (epoch % max(1, cfg.evaluate_every_n_epochs)) == 0:
-                    score = float(cfg.score_calculator.calculate_score(self.net))
-                    score_vs_epoch[epoch] = score
-                    if best_score is None or score < best_score:
-                        best_score = score
+                    last_score = float(
+                        cfg.score_calculator.calculate_score(self.net)
+                    )
+                    score_vs_epoch[epoch] = last_score
+                    if best_score is None or last_score < best_score:
+                        best_score = last_score
                         best_epoch = epoch
-                        cfg.model_saver.save_best_model(self.net, score)
+                        cfg.model_saver.save_best_model(self.net, last_score)
                     if cfg.save_last_model:
-                        cfg.model_saver.save_latest_model(self.net, score)
-                    stop_now = None
-                    for c in cfg.epoch_termination_conditions:
-                        if c.terminate(epoch, score):
-                            stop_now = c
-                            break
-                    if stop_now is not None:
-                        reason = TerminationReason.EPOCH_CONDITION
-                        details = repr(stop_now)
+                        cfg.model_saver.save_latest_model(self.net, last_score)
+                # score-free epoch conditions run EVERY epoch (so MaxEpochs
+                # cannot overshoot when evaluate_every_n_epochs > 1);
+                # score-based conditions only where a fresh score exists
+                stop_now = None
+                for c in cfg.epoch_termination_conditions:
+                    if c.requires_score and last_score is None:
+                        continue  # don't re-judge a stale score
+                    if c.terminate(epoch, last_score):
+                        stop_now = c
                         break
+                if stop_now is not None:
+                    reason = TerminationReason.EPOCH_CONDITION
+                    details = repr(stop_now)
+                    break
                 epoch += 1
         except Exception as e:  # capture, don't crash (reference :113)
             reason = TerminationReason.ERROR

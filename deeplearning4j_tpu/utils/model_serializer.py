@@ -27,13 +27,54 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-FORMAT_VERSION = 1
+FORMAT_VERSION = 2
 
 _CONFIG_JSON = "configuration.json"
 _COEFFICIENTS = "coefficients.bin"
 _UPDATER_STATE = "updaterState.bin"
 _LAYER_STATE = "layerState.bin"
+_UPDATER_STATE_NPZ = "updaterState.npz"
+_LAYER_STATE_NPZ = "layerState.npz"
 _META = "meta.json"
+
+
+def _tree_to_npz_bytes(tree) -> bytes:
+    """Serialize a pytree's leaves at their NATIVE dtype/shape (npz acts as
+    the per-leaf manifest: a shape/dtype mismatch on load is an error, not
+    a silent cast — v1's flat-f32 .bin lost f64/int state silently)."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    arrays = {f"leaf{i:05d}": np.asarray(l) for i, l in enumerate(leaves)}
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    return buf.getvalue()
+
+
+def _tree_from_npz_bytes(template, data: bytes):
+    leaves, treedef = jax.tree_util.tree_flatten(template)
+    with np.load(io.BytesIO(data)) as npz:
+        keys = sorted(npz.files)
+        if len(keys) != len(leaves):
+            raise ValueError(
+                f"saved state has {len(keys)} leaves, this "
+                f"configuration/updater expects {len(leaves)} — file does "
+                "not match"
+            )
+        out = []
+        for key, tmpl in zip(keys, leaves):
+            arr = npz[key]
+            if tuple(arr.shape) != tuple(np.shape(tmpl)):
+                raise ValueError(
+                    f"saved leaf {key} shape {arr.shape} != expected "
+                    f"{np.shape(tmpl)} — leaf-order drift or wrong file"
+                )
+            tmpl_dtype = np.asarray(tmpl).dtype
+            if arr.dtype != tmpl_dtype:
+                raise ValueError(
+                    f"saved leaf {key} dtype {arr.dtype} != expected "
+                    f"{tmpl_dtype} — leaf-order drift or wrong file"
+                )
+            out.append(jnp.asarray(arr))
+    return jax.tree_util.tree_unflatten(treedef, out)
 
 
 def _flatten_tree(tree) -> np.ndarray:
@@ -46,7 +87,7 @@ def _flatten_tree(tree) -> np.ndarray:
 
 
 def _unflatten_tree(template, vec: np.ndarray):
-    """Scatter vec into a pytree with template's structure/shapes/dtypes."""
+    """v1 compat: scatter a flat f32 vec into template's structure."""
     leaves, treedef = jax.tree_util.tree_flatten(template)
     out = []
     off = 0
@@ -68,34 +109,41 @@ def _unflatten_tree(template, vec: np.ndarray):
 def save_model(net, path: Union[str, os.PathLike], save_updater: bool = True) -> None:
     """Write a model zip (reference: ModelSerializer.writeModel :79-118)."""
     net._require_init()
+    coeffs = np.asarray(net.params())
     meta = {
         "format_version": FORMAT_VERSION,
         "network_type": type(net).__name__,
         "iteration": int(net.iteration),
         "epoch": int(net.epoch),
         "save_updater": bool(save_updater),
+        "coefficients_dtype": coeffs.dtype.str,  # e.g. "<f4", "<f8"
     }
     with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
         zf.writestr(_CONFIG_JSON, net.conf.to_json())
         zf.writestr(_META, json.dumps(meta, indent=2))
-        zf.writestr(
-            _COEFFICIENTS,
-            np.asarray(net.params(), dtype="<f4").tobytes(),
-        )
-        zf.writestr(_LAYER_STATE, _flatten_tree(net.state_list).astype("<f4").tobytes())
+        zf.writestr(_COEFFICIENTS, coeffs.astype(coeffs.dtype.newbyteorder("<")).tobytes())
+        zf.writestr(_LAYER_STATE_NPZ, _tree_to_npz_bytes(net.state_list))
         if save_updater:
-            zf.writestr(
-                _UPDATER_STATE,
-                _flatten_tree(net.upd_state).astype("<f4").tobytes(),
-            )
+            zf.writestr(_UPDATER_STATE_NPZ, _tree_to_npz_bytes(net.upd_state))
 
 
-def _read_vec(zf: zipfile.ZipFile, name: str) -> Optional[np.ndarray]:
+def _read_vec(zf: zipfile.ZipFile, name: str, dtype: str = "<f4") -> Optional[np.ndarray]:
     try:
         data = zf.read(name)
     except KeyError:
         return None
-    return np.frombuffer(data, dtype="<f4").copy()
+    return np.frombuffer(data, dtype=dtype).copy()
+
+
+def _read_state(zf: zipfile.ZipFile, npz_name: str, bin_name: str):
+    """Returns ("npz", bytes) for v2 files, ("vec", ndarray) for v1, or
+    None when absent."""
+    try:
+        return ("npz", zf.read(npz_name))
+    except KeyError:
+        pass
+    vec = _read_vec(zf, bin_name)
+    return None if vec is None else ("vec", vec)
 
 
 def load_model(path: Union[str, os.PathLike], load_updater: bool = True):
@@ -111,9 +159,14 @@ def load_model(path: Union[str, os.PathLike], load_updater: bool = True):
     with zipfile.ZipFile(path, "r") as zf:
         conf = config_from_json(zf.read(_CONFIG_JSON).decode("utf-8"))
         meta = json.loads(zf.read(_META).decode("utf-8"))
-        coeffs = _read_vec(zf, _COEFFICIENTS)
-        layer_state = _read_vec(zf, _LAYER_STATE)
-        upd_vec = _read_vec(zf, _UPDATER_STATE) if load_updater else None
+        coeffs = _read_vec(
+            zf, _COEFFICIENTS, meta.get("coefficients_dtype", "<f4")
+        )
+        layer_state = _read_state(zf, _LAYER_STATE_NPZ, _LAYER_STATE)
+        upd = (
+            _read_state(zf, _UPDATER_STATE_NPZ, _UPDATER_STATE)
+            if load_updater else None
+        )
 
     if isinstance(conf, MultiLayerConfiguration):
         net = MultiLayerNetwork(conf)
@@ -124,10 +177,19 @@ def load_model(path: Union[str, os.PathLike], load_updater: bool = True):
     net.init()
     if coeffs is not None:
         net.set_params(coeffs)
-    if layer_state is not None and layer_state.size:
-        net.state_list = _unflatten_tree(net.state_list, layer_state)
-    if upd_vec is not None and meta.get("save_updater", True):
-        net.upd_state = _unflatten_tree(net.upd_state, upd_vec)
+
+    def restore(template, entry):
+        kind, payload = entry
+        if kind == "npz":
+            return _tree_from_npz_bytes(template, payload)
+        return _unflatten_tree(template, payload)
+
+    if layer_state is not None and not (
+        layer_state[0] == "vec" and layer_state[1].size == 0
+    ):
+        net.state_list = restore(net.state_list, layer_state)
+    if upd is not None and meta.get("save_updater", True):
+        net.upd_state = restore(net.upd_state, upd)
     net.iteration = int(meta.get("iteration", 0))
     net.epoch = int(meta.get("epoch", 0))
     return net
