@@ -114,9 +114,14 @@ class ComputationGraph(NetworkBase):
     # -- forward -------------------------------------------------------------
 
     def _forward(self, params, states, inputs: Sequence, *, training, rng,
-                 input_masks: Optional[Sequence] = None, preout_outputs=False):
+                 input_masks: Optional[Sequence] = None, preout_outputs=False,
+                 stateful=False):
         """Pure forward over the cached topo order. Returns
-        (activations dict, new_states list)."""
+        (activations dict, new_states list). With preout_outputs, loss-head
+        vertices also record their post-dropout input features under
+        "<name>__features" (the center-loss term needs them). stateful
+        seeds empty RNN state so recurrent layers return their carry
+        (rnnTimeStep / TBPTT, reference: ComputationGraph rnn methods)."""
         conf = self.conf
         acts: Dict[str, jnp.ndarray] = dict(zip(conf.inputs, inputs))
         masks: Dict[str, jnp.ndarray] = {}
@@ -144,12 +149,15 @@ class ComputationGraph(NetworkBase):
                         timesteps = x.shape[1]
                 pidx = self._pidx[name]
                 lc = v.layer
+                st = states[pidx]
+                if stateful and _is_recurrent(lc) and st is None:
+                    st = {}  # empty dict triggers zero-state seed + carry
                 ctx = LayerContext(
                     training=training,
                     rng=jax.random.fold_in(rng, pidx) if rng is not None else None,
                     mask=sole_mask if (hasattr(x, "ndim") and x.ndim == 3) else None,
                     timesteps=timesteps,
-                    state=states[pidx],
+                    state=st,
                 )
                 if (
                     preout_outputs
@@ -159,6 +167,7 @@ class ComputationGraph(NetworkBase):
                     from deeplearning4j_tpu.nn.layers.core import apply_dropout
 
                     x = apply_dropout(x, lc.dropout, ctx)
+                    acts[name + "__features"] = x
                     x = _preout_of_output_layer(lc, params[pidx], x)
                     ns = None
                 else:
@@ -189,18 +198,35 @@ class ComputationGraph(NetworkBase):
                     and isinstance(v.layer, _OUTPUT_LAYER_TYPES)):
                 continue
             lc = v.layer
-            if isinstance(lc, L.CenterLossOutputLayer):
-                raise NotImplementedError(
-                    "CenterLossOutputLayer in a ComputationGraph is not "
-                    "wired yet; use MultiLayerNetwork (which implements the "
-                    "center term + EMA center updates)"
-                )
             lm = l_masks[i] if l_masks is not None else None
             per_ex = loss_value(
                 lc.loss, ys[i], self.policy.cast_output(acts[name]),
                 lc.activation, lm,
             )
             score = score + jnp.mean(per_ex)
+            if isinstance(lc, L.CenterLossOutputLayer):
+                # center loss head (reference: CenterLossOutputLayer.java):
+                # + lambda * mean(0.5||f - c_y||^2) on the head's input
+                # features, centers EMA-updated as non-trainable state
+                pidx = self._pidx[name]
+                feats = acts[name + "__features"]
+                centers = states[pidx]["centers"].astype(feats.dtype)
+                y32 = ys[i].astype(feats.dtype)
+                diff = feats - y32 @ centers
+                center_per_ex = 0.5 * jnp.sum(diff * diff, axis=-1)
+                score = score + lc.lambda_ * jnp.mean(center_per_ex)
+                if training:
+                    f_sg = jax.lax.stop_gradient(feats)
+                    counts = jnp.sum(y32, axis=0)[:, None]
+                    means = (y32.T @ f_sg) / jnp.maximum(counts, 1.0)
+                    updated = jnp.where(
+                        counts > 0,
+                        (1.0 - lc.alpha) * centers + lc.alpha * means,
+                        centers,
+                    )
+                    new_states[pidx] = {
+                        "centers": updated.astype(states[pidx]["centers"].dtype)
+                    }
             n_heads += 1
         if n_heads == 0:
             raise ValueError(
@@ -326,17 +352,144 @@ class ComputationGraph(NetworkBase):
         return self._run_fit(iterator, epochs, async_prefetch)
 
     def _fit_dataset(self, ds):
-        if self.conf.backprop_type == "tbptt":
-            raise NotImplementedError(
-                "TBPTT for ComputationGraph is not implemented yet; use "
-                "BackpropType.STANDARD or a MultiLayerNetwork"
-            )
         mds = _as_multidataset(ds)
+        if (
+            self.conf.backprop_type == "tbptt"
+            and any(f.ndim == 3 for f in mds.features)
+        ):
+            self._fit_tbptt(mds)
+            return
         states, _ = self._fit_step(
             mds.features, mds.labels, mds.features_masks, mds.labels_masks
         )
         self.state_list = states
         self._notify(mds.num_examples())
+
+    def _fit_tbptt(self, mds: MultiDataSet):
+        """Truncated BPTT over a MultiDataSet: the time axis of every 3-d
+        feature/label/mask is segmented into tbptt_fwd_length chunks; RNN
+        state carries across segment steps (reference:
+        ComputationGraph.doTruncatedBPTT — same segment loop as the MLN
+        path, generalized to multi-input/multi-output)."""
+        T = max(f.shape[1] for f in mds.features if f.ndim == 3)
+        seg = int(self.conf.tbptt_fwd_length)
+        bwd = int(self.conf.tbptt_bwd_length)
+        states = list(self.state_list)
+        for i, lc in enumerate(self._layer_confs):
+            if _is_recurrent(lc) and states[i] is None:
+                states[i] = {}
+
+        def cut(sl):
+            feats = [f[:, sl] if f.ndim == 3 else f for f in mds.features]
+            labels = [y[:, sl] if y.ndim == 3 else y for y in mds.labels]
+            fms = None
+            if mds.features_masks is not None:
+                fms = [
+                    None if m is None else m[:, sl]
+                    for m in mds.features_masks
+                ]
+            lms = None
+            if mds.labels_masks is not None:
+                lms = [
+                    None if m is None else m[:, sl]
+                    for m in mds.labels_masks
+                ]
+            return (feats, labels, fms, lms)
+
+        for start in range(0, T, seg):
+            end = min(start + seg, T)
+            if bwd < end - start:
+                boundary = end - bwd
+                states, _ = self._fit_step_truncated(
+                    cut(slice(start, boundary)), cut(slice(boundary, end)),
+                    stateful_states=states,
+                )
+            else:
+                states, _ = self._fit_step(
+                    *cut(slice(start, end)), stateful_states=states
+                )
+            self._notify(mds.num_examples())
+        # persist only non-RNN state (running stats); RNN carry is per-batch
+        self.state_list = [
+            st if not _is_recurrent(lc) else self.state_list[i]
+            for i, (lc, st) in enumerate(zip(self._layer_confs, states))
+        ]
+
+    def _fit_step_truncated(self, dataA, dataB, stateful_states):
+        """TBPTT segment step with a backward-truncation boundary: slice A
+        advances state under stop_gradient (score counts, no gradient),
+        slice B backprops — gradient depth is exactly tbptt_bwd_length
+        (same design as MultiLayerNetwork._build_truncated_bwd_step)."""
+        if getattr(self, "_trunc_step_fn", None) is None:
+            gnorm = self.net_conf.gradient_normalization
+            gthresh = self.net_conf.gradient_normalization_threshold
+            mults = self._lr_mult_tree()
+            tmask = self._trainable_mask()
+            updater = self.updater_def
+            minimize = self.net_conf.minimize
+
+            def step(params, states, upd_state, dA, dB, lr, t, rng):
+                def loss_fn(p):
+                    xA, yA, fmA, lmA = dA
+                    xB, yB, fmB, lmB = dB
+                    lossA, statesA = self._loss(p, states, xA, yA, fmA, lmA, rng)
+                    carried = self._merge_states(states, statesA)
+                    carried = jax.tree_util.tree_map(
+                        jax.lax.stop_gradient, carried
+                    )
+                    lossB, statesB = self._loss(
+                        p, carried, xB, yB, fmB, lmB,
+                        None if rng is None else jax.random.fold_in(rng, 1),
+                    )
+                    nA = max(x.shape[1] for x in xA if x.ndim == 3)
+                    nB = max(x.shape[1] for x in xB if x.ndim == 3)
+                    score = (
+                        jax.lax.stop_gradient(lossA) * nA + lossB * nB
+                    ) / (nA + nB)
+                    return score, self._merge_states(carried, statesB)
+
+                (score, new_states), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True
+                )(params)
+                if not minimize:
+                    grads = jax.tree_util.tree_map(lambda g: -g, grads)
+                grads = [
+                    {k: g[k] * m[k] for k in g} for g, m in zip(grads, tmask)
+                ]
+                grads = normalize_gradients(grads, gnorm, gthresh)
+                lr_tree = [
+                    {k: lr * m[k] for k in g} for g, m in zip(grads, mults)
+                ]
+                updates, new_upd = updater.apply_tree(grads, upd_state, lr_tree, t)
+                new_params = jax.tree_util.tree_map(jnp.add, params, updates)
+                return new_params, new_states, new_upd, score
+
+            backend = jax.default_backend()
+            donate = (0, 2) if backend != "cpu" else ()
+            self._trunc_step_fn = jax.jit(step, donate_argnums=donate)
+
+        lr = schedule_lr(self.net_conf, self.iteration)
+        rng = jax.random.fold_in(
+            jax.random.PRNGKey(self.net_conf.seed ^ 0x5EED), self.iteration
+        )
+        jas = lambda t: None if t is None else [
+            None if a is None else jnp.asarray(a) for a in t
+        ]
+        pack = lambda d: (
+            [jnp.asarray(x) for x in d[0]], [jnp.asarray(y) for y in d[1]],
+            jas(d[2]), jas(d[3]),
+        )
+        params, states, upd, score = self._trunc_step_fn(
+            self.params_list, stateful_states, self.upd_state,
+            pack(dataA), pack(dataB),
+            jnp.asarray(lr, jnp.float32), jnp.asarray(float(self.iteration)),
+            rng,
+        )
+        self.params_list = params
+        self.upd_state = upd
+        self._score = score
+        self.iteration += 1
+        return states, score
 
     # -- inference -----------------------------------------------------------
 
@@ -405,8 +558,11 @@ class ComputationGraph(NetworkBase):
         )
         return float(s)
 
-    def evaluate(self, data, labels=None, batch_size: int = 256) -> Evaluation:
-        """Classification evaluation for single-input single-output graphs."""
+    def evaluate(self, data, labels=None, batch_size: int = 256,
+                 output_index: int = 0) -> Evaluation:
+        """Classification evaluation; multi-input graphs evaluate on all
+        features, multi-output graphs on the head selected by
+        output_index (reference: ComputationGraph.evaluate)."""
         ev = Evaluation()
         if isinstance(data, (DataSetIterator, MultiDataSetIterator)):
             batches = data
@@ -418,10 +574,51 @@ class ComputationGraph(NetworkBase):
             mds = _as_multidataset(b)
             out = self.output(*mds.features, input_masks=mds.features_masks)
             if isinstance(out, list):
-                out = out[0]
-            lm = None if mds.labels_masks is None else mds.labels_masks[0]
-            ev.eval_batch(mds.labels[0], out, lm)
+                out = out[output_index]
+            lm = (
+                None if mds.labels_masks is None
+                else mds.labels_masks[output_index]
+            )
+            ev.eval_batch(mds.labels[output_index], out, lm)
         return ev
+
+    # -- rnn streaming inference ---------------------------------------------
+
+    def rnn_time_step(self, *inputs):
+        """Stateful streaming inference over the graph (reference:
+        ComputationGraph.rnnTimeStep). Each input: [batch, time, nIn] (or
+        [batch, nIn] for a single step). Returns outputs in set_outputs
+        order (single array for a single-output graph)."""
+        self._require_init()
+        xs = [jnp.asarray(x) for x in inputs]
+        single = all(x.ndim == 2 for x in xs)
+        if single:
+            xs = [x[:, None, :] for x in xs]
+        # only the recurrent carry is held between calls; non-recurrent
+        # state (BN running stats) is always read fresh from state_list so
+        # streaming matches output() even after an interleaved fit()
+        carry = getattr(self, "_rnn_carry", None) or {}
+        states = [
+            carry.get(i, {}) if _is_recurrent(lc) else self.state_list[i]
+            for i, lc in enumerate(self._layer_confs)
+        ]
+        acts, new_states = self._forward(
+            self.params_list, states,
+            [self.policy.cast_input(x) for x in xs],
+            training=False, rng=None, stateful=True,
+        )
+        merged = self._merge_states(states, new_states)
+        self._rnn_carry = {
+            i: merged[i]
+            for i, lc in enumerate(self._layer_confs) if _is_recurrent(lc)
+        }
+        outs = [self.policy.cast_output(acts[n]) for n in self.conf.outputs]
+        if single:
+            outs = [o[:, 0] if o.ndim == 3 else o for o in outs]
+        return outs[0] if len(outs) == 1 else outs
+
+    def rnn_clear_previous_state(self):
+        self._rnn_carry = None
 
     def clone(self) -> "ComputationGraph":
         import copy

@@ -686,17 +686,23 @@ class MultiLayerNetwork(NetworkBase):
         single = xx.ndim == 2
         if single:
             xx = xx[:, None, :]
-        states = self._rnn_states
-        if states is None:
-            states = [
-                {} if _is_recurrent(c) else self.state_list[i]
-                for i, c in enumerate(self.layer_confs)
-            ]
+        # only the recurrent carry persists between calls; non-recurrent
+        # state (BN running stats) is read fresh from state_list so
+        # streaming matches output() even after an interleaved fit()
+        carry = self._rnn_states or {}
+        states = [
+            carry.get(i, {}) if _is_recurrent(c) else self.state_list[i]
+            for i, c in enumerate(self.layer_confs)
+        ]
         out, new_states = self._forward(
             self.params_list, states, self.policy.cast_input(xx),
             training=False, rng=None, stateful=True,
         )
-        self._rnn_states = self._merge_states(states, new_states)
+        merged = self._merge_states(states, new_states)
+        self._rnn_states = {
+            i: merged[i]
+            for i, c in enumerate(self.layer_confs) if _is_recurrent(c)
+        }
         out = self.policy.cast_output(out)
         return out[:, 0] if single else out
 

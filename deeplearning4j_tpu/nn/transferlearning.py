@@ -28,6 +28,15 @@ from deeplearning4j_tpu.nn.layers.registry import init_layer_params, init_layer_
 from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 
 
+def _copy_tree(tree):
+    """Deep-copy a param/state pytree's device arrays. Transferred nets
+    must own their buffers: jitted train steps donate params on TPU/GPU,
+    so a shared array would be deleted under the source network."""
+    import jax.numpy as jnp
+
+    return jax.tree_util.tree_map(lambda a: jnp.array(a, copy=True), tree)
+
+
 class TransferLearning:
     class Builder:
         def __init__(self, net: MultiLayerNetwork):
@@ -147,14 +156,191 @@ class TransferLearning:
                 input_type=copy.deepcopy(src.conf.input_type),
             )
             new_net = MultiLayerNetwork(new_conf).init()
-            # parameter transfer: share surviving layers' arrays, keep the
-            # fresh init for replaced/added layers
+            # parameter transfer: COPY surviving layers' arrays (the train
+            # step donates its param buffers on TPU/GPU — sharing would let
+            # new_net.fit() invalidate the source network's arrays)
             for i in range(len(confs)):
                 if i < len(src.params_list) and i not in reinit:
-                    new_net.params_list[i] = src.params_list[i]
-                    s = src.state_list[i]
-                    new_net.state_list[i] = None if s is None else dict(s)
+                    new_net.params_list[i] = _copy_tree(src.params_list[i])
+                    new_net.state_list[i] = _copy_tree(src.state_list[i])
             return new_net
+
+
+class GraphTransferLearning:
+    """Transfer learning for ComputationGraph (reference:
+    TransferLearning.GraphBuilder in nn/transferlearning/
+    TransferLearning.java): fineTune, setFeatureExtractor (freeze every
+    ancestor of the named vertices, inclusive), removeVertexAndConnections,
+    addLayer/addVertex, nOutReplace, setOutputs. Exposed as
+    TransferLearning.GraphBuilder for API parity."""
+
+    def __init__(self, net):
+        net._require_init()
+        self._src = net
+        self._fine_tune: Dict = {}
+        self._freeze_at: List[str] = []
+        self._removed: List[str] = []
+        self._added_layers: List[tuple] = []  # (name, conf, inputs, pp)
+        self._added_vertices: List[tuple] = []  # (name, vertex, inputs)
+        self._replacements: Dict[str, dict] = {}
+        self._new_outputs: Optional[List[str]] = None
+
+    def fine_tune_configuration(self, **overrides) -> "GraphTransferLearning":
+        self._fine_tune.update(overrides)
+        return self
+
+    def set_feature_extractor(self, *vertex_names: str) -> "GraphTransferLearning":
+        """Freeze the named vertices and all their ancestors (reference:
+        GraphBuilder.setFeatureExtractor)."""
+        self._freeze_at.extend(vertex_names)
+        return self
+
+    def remove_vertex_and_connections(self, name: str) -> "GraphTransferLearning":
+        self._removed.append(name)
+        return self
+
+    def n_out_replace(self, layer_name: str, n_out: int,
+                      weight_init: Optional[str] = None) -> "GraphTransferLearning":
+        self._replacements[layer_name] = {
+            "n_out": int(n_out), "weight_init": weight_init,
+        }
+        return self
+
+    def add_layer(self, name: str, layer_conf, *inputs: str,
+                  preprocessor=None) -> "GraphTransferLearning":
+        self._added_layers.append((name, layer_conf, list(inputs), preprocessor))
+        return self
+
+    def add_vertex(self, name: str, vertex, *inputs: str) -> "GraphTransferLearning":
+        self._added_vertices.append((name, vertex, list(inputs)))
+        return self
+
+    def set_outputs(self, *names: str) -> "GraphTransferLearning":
+        self._new_outputs = list(names)
+        return self
+
+    def build(self):
+        from deeplearning4j_tpu.nn.compgraph import ComputationGraph
+        from deeplearning4j_tpu.nn.conf.graph import (
+            ComputationGraphConfiguration,
+            LayerVertex,
+        )
+        from deeplearning4j_tpu.nn.conf.network import _apply_defaults
+
+        src = self._src
+        conf = src.conf
+        vertices = {k: copy.deepcopy(v) for k, v in conf.vertices.items()}
+        vertex_inputs = {k: list(v) for k, v in conf.vertex_inputs.items()}
+        outputs = list(self._new_outputs or conf.outputs)
+
+        # removals: vertex + every edge pointing at it
+        for name in self._removed:
+            if name not in vertices:
+                raise ValueError(f"cannot remove unknown vertex {name!r}")
+            del vertices[name]
+            del vertex_inputs[name]
+            for k, ins in vertex_inputs.items():
+                if name in ins:
+                    raise ValueError(
+                        f"vertex {k!r} still consumes removed vertex "
+                        f"{name!r}; remove or rewire it first"
+                    )
+            outputs = [o for o in outputs if o != name]
+
+        reinit = set()
+
+        # nOutReplace: change width + rewire direct consumers' n_in
+        for lname, spec in self._replacements.items():
+            v = vertices.get(lname)
+            if not isinstance(v, LayerVertex):
+                raise ValueError(f"{lname!r} is not a layer vertex")
+            inner = v.layer.inner if isinstance(v.layer, L.FrozenLayer) else v.layer
+            inner.n_out = spec["n_out"]
+            if spec["weight_init"]:
+                inner.weight_init = spec["weight_init"]
+            reinit.add(lname)
+            for cname, ins in vertex_inputs.items():
+                if lname not in ins:
+                    continue
+                cv_obj = vertices.get(cname)
+                if isinstance(cv_obj, LayerVertex):
+                    cv = cv_obj.layer
+                    c_inner = cv.inner if isinstance(cv, L.FrozenLayer) else cv
+                    if hasattr(c_inner, "n_in"):
+                        c_inner.n_in = spec["n_out"]
+                        reinit.add(cname)
+                else:
+                    # a non-layer consumer (Merge/ElementWise/...) changes
+                    # how the new width propagates — refuse loudly instead
+                    # of leaving stale n_in deeper in the graph (the
+                    # reference's GraphBuilder errors here too)
+                    raise ValueError(
+                        f"n_out_replace({lname!r}) feeds non-layer vertex "
+                        f"{cname!r}; rewire downstream widths explicitly "
+                        "(remove_vertex_and_connections + add_layer)"
+                    )
+
+        # additions
+        net_conf = copy.deepcopy(src.net_conf)
+        for k, val in self._fine_tune.items():
+            if not hasattr(net_conf, k):
+                raise ValueError(f"unknown fine-tune hyperparameter {k!r}")
+            setattr(net_conf, k, val)
+        for name, vertex, ins in self._added_vertices:
+            if name in vertices:
+                raise ValueError(f"duplicate vertex name {name!r}")
+            vertices[name] = copy.deepcopy(vertex)
+            vertex_inputs[name] = list(ins)
+        for name, lc, ins, pp in self._added_layers:
+            if name in vertices:
+                raise ValueError(f"duplicate vertex name {name!r}")
+            lc = copy.deepcopy(lc)
+            _apply_defaults(lc, net_conf)
+            vertices[name] = LayerVertex(layer=lc, preprocessor=pp)
+            vertex_inputs[name] = list(ins)
+            reinit.add(name)
+
+        # freeze: named vertices + all ancestors
+        if self._freeze_at:
+            frozen = set()
+            stack = list(self._freeze_at)
+            while stack:
+                n = stack.pop()
+                if n in frozen or n in conf.inputs:
+                    continue
+                frozen.add(n)
+                stack.extend(vertex_inputs.get(n, []))
+            for n in frozen:
+                v = vertices.get(n)
+                if isinstance(v, LayerVertex) and not isinstance(
+                    v.layer, L.FrozenLayer
+                ):
+                    v.layer = L.FrozenLayer(inner=v.layer)
+
+        new_conf = ComputationGraphConfiguration(
+            net_conf=net_conf,
+            inputs=list(conf.inputs),
+            outputs=outputs,
+            vertices=vertices,
+            vertex_inputs=vertex_inputs,
+            backprop_type=conf.backprop_type,
+            tbptt_fwd_length=conf.tbptt_fwd_length,
+            tbptt_bwd_length=conf.tbptt_bwd_length,
+            input_types=copy.deepcopy(conf.input_types),
+        )
+        new_net = ComputationGraph(new_conf).init()
+        # parameter transfer by vertex name (topo order may have changed);
+        # arrays are COPIED so donation in new_net's train step cannot
+        # invalidate the source network's buffers
+        for name, new_idx in new_net._pidx.items():
+            if name in src._pidx and name not in reinit:
+                old_idx = src._pidx[name]
+                new_net.params_list[new_idx] = _copy_tree(src.params_list[old_idx])
+                new_net.state_list[new_idx] = _copy_tree(src.state_list[old_idx])
+        return new_net
+
+
+TransferLearning.GraphBuilder = GraphTransferLearning
 
 
 class TransferLearningHelper:
@@ -189,10 +375,12 @@ class TransferLearningHelper:
             },
         )
         self.tail = MultiLayerNetwork(tail_conf).init()
-        self.tail.params_list = list(net.params_list[self.boundary:])
+        # copies, not shares: tail.fit() donates its param buffers
+        self.tail.params_list = [
+            _copy_tree(p) for p in net.params_list[self.boundary:]
+        ]
         self.tail.state_list = [
-            None if s is None else dict(s)
-            for s in net.state_list[self.boundary:]
+            _copy_tree(s) for s in net.state_list[self.boundary:]
         ]
 
     def featurize(self, ds: DataSet) -> DataSet:

@@ -408,3 +408,191 @@ def test_clone_carries_updater_and_counters():
     np.testing.assert_allclose(
         np.asarray(net.params()), np.asarray(other.params()), atol=0
     )
+
+
+# -- round-3 parity: TBPTT / rnnTimeStep / CenterLoss / transfer -------------
+
+
+def _chain_rnn_mln_and_cg(seed=21, tbptt=True, fwd=4, bwd=None):
+    """The same LSTM chain as an MLN and as a CG (identical seeds =>
+    identical init, since both fold_in layer index 0,1)."""
+    from deeplearning4j_tpu.nn.conf import BackpropType
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    def base():
+        return (
+            NeuralNetConfiguration.builder()
+            .seed(seed)
+            .updater("sgd")
+            .learning_rate(0.1)
+            .weight_init("xavier")
+        )
+
+    lb = (
+        base().list()
+        .layer(LSTM(n_out=6, activation="tanh"))
+        .layer(RnnOutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+        .set_input_type(InputType.recurrent(3))
+    )
+    gb = (
+        base().graph_builder()
+        .add_inputs("in")
+        .add_layer("lstm", LSTM(n_out=6, activation="tanh"), "in")
+        .add_layer("out", RnnOutputLayer(n_out=2, activation="softmax",
+                                         loss="mcxent"), "lstm")
+        .set_outputs("out")
+        .set_input_types(InputType.recurrent(3))
+    )
+    if tbptt:
+        lb = lb.backprop_type(BackpropType.TRUNCATED_BPTT).t_bptt_lengths(fwd, bwd)
+        gb = gb.backprop_type("tbptt").t_bptt_lengths(fwd, bwd)
+    return MultiLayerNetwork(lb.build()).init(), ComputationGraph(gb.build()).init()
+
+
+def _rnn_xy(n=8, t=12, nin=3, nout=2, seed=5):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, t, nin)).astype(np.float32)
+    y = np.zeros((n, t, nout), np.float32)
+    idx = rng.integers(0, nout, (n, t))
+    for i in range(n):
+        y[i, np.arange(t), idx[i]] = 1.0
+    return x, y
+
+
+def test_cg_tbptt_matches_mln():
+    """CG TBPTT segment loop == MLN TBPTT on the same chain (reference:
+    ComputationGraph.doTruncatedBPTT mirrors the MLN path)."""
+    mln, cg = _chain_rnn_mln_and_cg(tbptt=True, fwd=4)
+    np.testing.assert_allclose(np.asarray(mln.params()),
+                               np.asarray(cg.params()), atol=0)
+    x, y = _rnn_xy()
+    mln.fit(x, y, epochs=2, batch_size=8, async_prefetch=False)
+    cg.fit(x, y, epochs=2, batch_size=8, async_prefetch=False)
+    assert mln.iteration == cg.iteration  # same number of segment steps
+    np.testing.assert_allclose(np.asarray(mln.params()),
+                               np.asarray(cg.params()), rtol=2e-5, atol=2e-6)
+
+
+def test_cg_tbptt_bwd_truncation_matches_mln():
+    mln, cg = _chain_rnn_mln_and_cg(tbptt=True, fwd=6, bwd=3)
+    x, y = _rnn_xy(t=12)
+    mln.fit(x, y, epochs=1, batch_size=8, async_prefetch=False)
+    cg.fit(x, y, epochs=1, batch_size=8, async_prefetch=False)
+    np.testing.assert_allclose(np.asarray(mln.params()),
+                               np.asarray(cg.params()), rtol=2e-5, atol=2e-6)
+
+
+def test_cg_rnn_time_step_streaming_equivalence():
+    """Streaming chunks through rnn_time_step == one full-sequence output
+    (reference: ComputationGraph.rnnTimeStep)."""
+    _, cg = _chain_rnn_mln_and_cg(tbptt=False)
+    x, _ = _rnn_xy(n=4, t=10)
+    full = np.asarray(cg.output(x))
+    cg.rnn_clear_previous_state()
+    c1 = np.asarray(cg.rnn_time_step(x[:, :4]))
+    c2 = np.asarray(cg.rnn_time_step(x[:, 4:7]))
+    c3 = np.asarray(cg.rnn_time_step(x[:, 7:]))
+    streamed = np.concatenate([c1, c2, c3], axis=1)
+    np.testing.assert_allclose(streamed, full, rtol=2e-5, atol=2e-6)
+    # single-step [b, nin] form
+    cg.rnn_clear_previous_state()
+    s = np.asarray(cg.rnn_time_step(x[:, 0]))
+    np.testing.assert_allclose(s, full[:, 0], rtol=2e-5, atol=2e-6)
+
+
+def test_cg_center_loss_head():
+    """CenterLossOutputLayer as a CG head: trains, centers move (reference:
+    CenterLossOutputLayer.java wired through the graph path)."""
+    from deeplearning4j_tpu.nn.conf import CenterLossOutputLayer
+
+    conf = (
+        _gb(updater="adam", lr=0.05)
+        .add_inputs("in")
+        .add_layer("d", DenseLayer(n_out=8, activation="relu"), "in")
+        .add_layer("out", CenterLossOutputLayer(
+            n_out=3, activation="softmax", loss="mcxent",
+            lambda_=0.1, alpha=0.3), "d")
+        .set_outputs("out")
+        .set_input_types(InputType.feed_forward(6))
+        .build()
+    )
+    net = ComputationGraph(conf).init()
+    x, y = _xy(48, 6, 3)
+    pidx = net._pidx["out"]
+    centers0 = np.asarray(net.state_list[pidx]["centers"])
+    net.fit(x, y, epochs=40, batch_size=48, async_prefetch=False)
+    centers1 = np.asarray(net.state_list[pidx]["centers"])
+    assert not np.allclose(centers0, centers1)  # EMA updates happened
+    assert net.evaluate(x, y).accuracy() > 0.8
+    # the center term shapes the features: same run with lambda_=0 must
+    # leave larger within-class scatter (relative to feature scale) than
+    # the center-pulled run
+    def within_scatter(trained):
+        feats = np.asarray(trained.feed_forward(x)["d"])
+        labels = y.argmax(1)
+        scale = np.linalg.norm(feats - feats.mean(0), axis=1).mean() + 1e-12
+        return np.mean([
+            np.linalg.norm(
+                feats[labels == k] - feats[labels == k].mean(0), axis=1
+            ).mean()
+            for k in range(3)
+        ]) / scale
+
+    conf0 = (
+        _gb(updater="adam", lr=0.05)
+        .add_inputs("in")
+        .add_layer("d", DenseLayer(n_out=8, activation="relu"), "in")
+        .add_layer("out", CenterLossOutputLayer(
+            n_out=3, activation="softmax", loss="mcxent",
+            lambda_=0.0, alpha=0.3), "d")
+        .set_outputs("out")
+        .set_input_types(InputType.feed_forward(6))
+        .build()
+    )
+    net0 = ComputationGraph(conf0).init()
+    net0.fit(x, y, epochs=40, batch_size=48, async_prefetch=False)
+    assert within_scatter(net) < within_scatter(net0)
+
+
+def test_cg_transfer_learning():
+    from deeplearning4j_tpu.nn.conf import layers as L
+    from deeplearning4j_tpu.nn.transferlearning import TransferLearning
+
+    conf = (
+        _gb(updater="sgd", lr=0.1)
+        .add_inputs("in")
+        .add_layer("f1", DenseLayer(n_out=10, activation="relu"), "in")
+        .add_layer("f2", DenseLayer(n_out=8, activation="relu"), "f1")
+        .add_layer("out", OutputLayer(n_out=3, activation="softmax"), "f2")
+        .set_outputs("out")
+        .set_input_types(InputType.feed_forward(6))
+        .build()
+    )
+    src = ComputationGraph(conf).init()
+    x, y = _xy(32, 6, 3)
+    src.fit(x, y, epochs=3, batch_size=32, async_prefetch=False)
+
+    # freeze the feature front, swap the head for a 4-class one
+    new = (
+        TransferLearning.GraphBuilder(src)
+        .set_feature_extractor("f2")
+        .remove_vertex_and_connections("out")
+        .add_layer("newout", L.OutputLayer(n_in=8, n_out=4,
+                                           activation="softmax"), "f2")
+        .set_outputs("newout")
+        .build()
+    )
+    # surviving params are shared/copied
+    np.testing.assert_array_equal(
+        np.asarray(new.params_list[new._pidx["f1"]]["W"]),
+        np.asarray(src.params_list[src._pidx["f1"]]["W"]),
+    )
+    # frozen front must not move during fit
+    w_before = np.asarray(new.params_list[new._pidx["f1"]]["W"]).copy()
+    y4 = np.zeros((32, 4), np.float32)
+    y4[np.arange(32), np.random.default_rng(1).integers(0, 4, 32)] = 1.0
+    new.fit(x, y4, epochs=3, batch_size=32, async_prefetch=False)
+    np.testing.assert_array_equal(
+        np.asarray(new.params_list[new._pidx["f1"]]["W"]), w_before
+    )
+    assert new.output(x).shape == (32, 4)
