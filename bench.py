@@ -170,6 +170,53 @@ def bench_char_lstm(batch=64, seq_len=200, tbptt=50, vocab=77, hidden=200,
     }
 
 
+def bench_word2vec(vocab=10_000, n_sents=2_000, sent_len=40, batch=8192,
+                   layer_size=128, negative=5):
+    """Word2Vec skip-gram words/sec (BASELINE.md Word2Vec workload;
+    reference hot loop: SkipGram.java:271 native aggregate ops). Synthetic
+    Zipf corpus; measures the device update path + host batching, i.e.
+    exactly what SequenceVectors.fit does after vocab construction."""
+    from deeplearning4j_tpu.nlp.sequencevectors import (
+        SequenceVectors,
+        VectorsConfiguration,
+    )
+
+    on_tpu = jax.default_backend() not in ("cpu",)
+    if not on_tpu:
+        vocab, n_sents, batch, layer_size = 1_000, 200, 1024, 32
+    rng = np.random.default_rng(0)
+    p = 1.0 / np.arange(1, vocab + 1)
+    p /= p.sum()
+    words = [f"w{i}" for i in range(vocab)]
+    sents = [
+        [words[j] for j in rng.choice(vocab, p=p, size=sent_len)]
+        for i in range(n_sents)
+    ]
+    conf = VectorsConfiguration(
+        layer_size=layer_size, window=5, min_word_frequency=1, epochs=1,
+        negative=negative, use_hierarchic_softmax=False, batch_size=batch,
+        sampling=1e-3,
+    )
+    sv = SequenceVectors(conf, sents)
+    sv.build_vocab()
+    indexed = sv._index_sentences(sents)
+    total_words = sum(int(s.size) for s in indexed)
+    sv.train_indexed(indexed[: max(2, n_sents // 10)])  # warmup/compile
+    t0 = time.perf_counter()
+    sv.train_indexed(indexed)
+    float(np.asarray(sv.lookup.syn0[0, 0]))  # sync
+    dt = time.perf_counter() - t0
+    return {
+        "value": round(total_words / dt, 1),
+        "unit": "words/sec/chip",
+        "vocab": vocab,
+        "layer_size": layer_size,
+        "negative": negative,
+        "total_words": total_words,
+        "seconds": round(dt, 3),
+    }
+
+
 def main():
     workloads = {}
     errors = {}
@@ -177,6 +224,7 @@ def main():
         ("resnet50", bench_resnet50),
         ("lenet", bench_lenet),
         ("char_lstm", bench_char_lstm),
+        ("word2vec", bench_word2vec),
     ):
         try:
             workloads[name] = fn()

@@ -1,0 +1,47 @@
+"""NLP / embeddings stack.
+
+Analog of the reference's deeplearning4j-nlp-parent (~46k LoC, SURVEY.md
+§2.7): a generic SequenceVectors trainer over sequence elements with
+pluggable learning algorithms (SkipGram, CBOW, DM, DBOW), Word2Vec /
+ParagraphVectors facades, vocab construction + Huffman coding for
+hierarchical softmax, tokenization SPI, and WordVectorSerializer interop.
+
+TPU-first redesign of the hot path: the reference batches skip-gram
+updates into native AggregateSkipGram ops executed by libnd4j
+(models/embeddings/learning/impl/elements/SkipGram.java:271); here the
+same batching feeds ONE jitted XLA step that gathers embedding rows,
+computes the sigmoid losses for hierarchical-softmax nodes and/or negative
+samples, and scatter-adds the updates in place (donated buffers).
+"""
+
+from deeplearning4j_tpu.nlp.tokenization import (
+    CommonPreprocessor,
+    DefaultTokenizerFactory,
+    NGramTokenizerFactory,
+    TokenizerFactory,
+)
+from deeplearning4j_tpu.nlp.vocab import Huffman, VocabCache, VocabConstructor
+from deeplearning4j_tpu.nlp.lookup import InMemoryLookupTable
+from deeplearning4j_tpu.nlp.sequencevectors import (
+    SequenceVectors,
+    VectorsConfiguration,
+)
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+from deeplearning4j_tpu.nlp.paragraphvectors import ParagraphVectors
+from deeplearning4j_tpu.nlp.serializer import WordVectorSerializer
+
+__all__ = [
+    "CommonPreprocessor",
+    "DefaultTokenizerFactory",
+    "NGramTokenizerFactory",
+    "TokenizerFactory",
+    "Huffman",
+    "VocabCache",
+    "VocabConstructor",
+    "InMemoryLookupTable",
+    "SequenceVectors",
+    "VectorsConfiguration",
+    "Word2Vec",
+    "ParagraphVectors",
+    "WordVectorSerializer",
+]

@@ -1,0 +1,106 @@
+"""Embedding lookup table — the device-side buffers.
+
+Analog of the reference's InMemoryLookupTable
+(models/embeddings/inmemory/InMemoryLookupTable.java:55-97): syn0 (word
+vectors), syn1 (hierarchical-softmax inner-node weights), syn1neg
+(negative-sampling output weights), and the unigram sampling table. The
+reference keeps these as heap INDArrays plus a precomputed sigmoid
+expTable; here syn* live as jax device arrays updated in place by the
+jitted training steps (donation), and sigmoid is computed on the fly —
+a transcendental on TPU is cheaper than a gather.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nlp.vocab import VocabCache
+
+
+class InMemoryLookupTable:
+    def __init__(self, vocab: VocabCache, vector_length: int, *, seed: int = 12345,
+                 use_hs: bool = True, negative: int = 0, dtype=jnp.float32):
+        self.vocab = vocab
+        self.vector_length = int(vector_length)
+        self.use_hs = bool(use_hs)
+        self.negative = int(negative)
+        V, D = vocab.num_words(), self.vector_length
+        key = jax.random.PRNGKey(seed)
+        # word2vec init: syn0 ~ U(-0.5/D, 0.5/D), outputs zero
+        self.syn0 = (
+            (jax.random.uniform(key, (max(V, 1), D), dtype) - 0.5) / D
+        )
+        self.syn1 = (
+            jnp.zeros((max(V - 1, 1), D), dtype) if use_hs else None
+        )
+        self.syn1neg = (
+            jnp.zeros((max(V, 1), D), dtype) if negative > 0 else None
+        )
+        self._unigram: Optional[np.ndarray] = None
+
+    # -- unigram table for negative sampling ---------------------------------
+
+    def unigram_table(self, table_size: int = 1_000_000, power: float = 0.75) -> np.ndarray:
+        """Sampling table: word index repeated proportionally to
+        count^0.75 (reference: InMemoryLookupTable.makeTable)."""
+        if self._unigram is None or self._unigram.size != table_size:
+            counts = self.vocab.counts().astype(np.float64)
+            if counts.size == 0:
+                raise ValueError("empty vocab")
+            p = counts**power
+            p /= p.sum()
+            bounds = np.cumsum(p)
+            self._unigram = np.searchsorted(
+                bounds, (np.arange(table_size) + 0.5) / table_size
+            ).astype(np.int64)
+        return self._unigram
+
+    # -- vector access -------------------------------------------------------
+
+    def vector(self, word: str) -> Optional[np.ndarray]:
+        idx = self.vocab.index_of(word)
+        if idx < 0:
+            return None
+        return np.asarray(self.syn0[idx])
+
+    def vectors(self) -> np.ndarray:
+        return np.asarray(self.syn0[: self.vocab.num_words()])
+
+    def set_vectors(self, arr: np.ndarray):
+        self.syn0 = jnp.asarray(arr)
+
+    def similarity(self, a: str, b: str) -> float:
+        va, vb = self.vector(a), self.vector(b)
+        if va is None or vb is None:
+            return float("nan")
+        denom = np.linalg.norm(va) * np.linalg.norm(vb)
+        return float(va @ vb / denom) if denom else 0.0
+
+    def words_nearest(self, word_or_vec, top_n: int = 10):
+        """Cosine-nearest words — one device matmul over the whole table
+        (reference: WordVectors.wordsNearest)."""
+        if isinstance(word_or_vec, str):
+            v = self.vector(word_or_vec)
+            if v is None:
+                return []
+            exclude = {word_or_vec}
+        else:
+            v = np.asarray(word_or_vec)
+            exclude = set()
+        table = self.vectors()
+        norms = np.linalg.norm(table, axis=1) * (np.linalg.norm(v) + 1e-12)
+        sims = (table @ v) / np.maximum(norms, 1e-12)
+        order = np.argsort(-sims)
+        out = []
+        for i in order:
+            w = self.vocab.word_at_index(int(i))
+            if w in exclude:
+                continue
+            out.append((w, float(sims[i])))
+            if len(out) >= top_n:
+                break
+        return out

@@ -1,0 +1,201 @@
+"""ParagraphVectors (doc2vec).
+
+Analog of the reference's models/paragraphvectors/ParagraphVectors.java
+with the sequence learning algorithms DM (distributed memory: window mean
++ doc vector predicts the center word) and DBOW (doc vector alone
+predicts each word) from models/embeddings/learning/impl/sequence/.
+
+Doc vectors live in their own table; infer_vector trains a FRESH doc row
+with the word tables frozen (reference: ParagraphVectors.inferVector).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nlp.batching import (
+    BatchPlan,
+    generate_batches,
+    group_batches,
+    keep_probabilities,
+    subsample,
+)
+from deeplearning4j_tpu.nlp.learning import (
+    make_embedding_scan_step,
+    make_embedding_step,
+)
+from deeplearning4j_tpu.nlp.sequencevectors import (
+    SequenceVectors,
+    VectorsConfiguration,
+)
+from deeplearning4j_tpu.nlp.tokenization import (
+    DefaultTokenizerFactory,
+    TokenizerFactory,
+)
+
+
+class ParagraphVectors(SequenceVectors):
+    def __init__(self, conf: VectorsConfiguration,
+                 documents: Iterable[str], labels: Sequence[str],
+                 tokenizer: Optional[TokenizerFactory] = None,
+                 sequence_learning_algorithm: str = "dm"):
+        self.tokenizer = tokenizer or DefaultTokenizerFactory()
+        seqs = [self.tokenizer.create(d).get_tokens() for d in documents]
+        super().__init__(conf, seqs)
+        self.labels = list(labels)
+        if len(self.labels) != len(seqs):
+            raise ValueError("labels must align with documents")
+        self.sequence_algo = sequence_learning_algorithm
+        if self.sequence_algo not in ("dm", "dbow"):
+            raise ValueError("sequence_learning_algorithm must be dm|dbow")
+        self._label_index = {l: i for i, l in enumerate(self.labels)}
+        if len(self._label_index) != len(self.labels):
+            raise ValueError("duplicate document labels")
+        self.doc_vectors = None  # [num_docs, D]
+
+    def fit(self, sequences=None):
+        conf = self.conf
+        self.build_vocab()
+        indexed = self._index_sentences(self._sequences)
+        D = conf.layer_size
+        n_docs = len(self.labels)
+        key = jax.random.PRNGKey(conf.seed ^ 0xD0C)
+        self.doc_vectors = (
+            (jax.random.uniform(key, (n_docs, D), jnp.float32) - 0.5) / D
+        )
+
+        plan = BatchPlan(
+            batch_size=conf.batch_size,
+            context_size=1 if self.sequence_algo == "dbow" else 2 * conf.window,
+            hs_arrays=self.huffman.arrays() if self.huffman else None,
+            negative=conf.negative,
+            unigram=(
+                self.lookup.unigram_table() if conf.negative > 0 else None
+            ),
+            with_doc=True,
+        )
+        step = make_embedding_scan_step(
+            use_hs=conf.use_hierarchic_softmax, negative=conf.negative,
+            with_doc=True,
+        )
+        keep = keep_probabilities(self.vocab.counts(), conf.sampling)
+        # distinct placeholder buffers — donation forbids duplicates
+        dummy = lambda: jnp.zeros((1, D), jnp.float32)
+        syn0 = self.lookup.syn0
+        syn1 = self.lookup.syn1 if self.lookup.syn1 is not None else dummy()
+        syn1neg = (
+            self.lookup.syn1neg if self.lookup.syn1neg is not None else dummy()
+        )
+        doc = self.doc_vectors
+
+        unigram_dev = jnp.zeros((1,), jnp.int32)  # host-side negatives
+        base_key = jax.random.PRNGKey(conf.seed ^ 0x5EED)
+        # dm/dbow emit ~one example per word position
+        total_examples = max(
+            sum(int(s.size) for s in indexed) * conf.epochs * conf.iterations,
+            1,
+        )
+        seen = 0
+        for _ in range(conf.epochs):
+            sents = [subsample(s, keep, self._rng) for s in indexed]
+            for _ in range(conf.iterations):
+                for group, lrs, n_rows in group_batches(
+                    generate_batches(
+                        iter(sents), plan, window=conf.window,
+                        mode=self.sequence_algo, rng=self._rng,
+                        doc_ids=range(len(sents)),
+                    ),
+                    plan, conf.scan_size,
+                    lambda s: max(
+                        conf.learning_rate * (1.0 - (seen + s) / total_examples),
+                        conf.min_learning_rate,
+                    ),
+                ):
+                    syn0, syn1, syn1neg, doc, loss = step(
+                        syn0, syn1, syn1neg, doc, unigram_dev, group, lrs,
+                        jax.random.fold_in(base_key, seen),
+                    )
+                    seen += n_rows
+        self.lookup.syn0 = syn0
+        if self.lookup.syn1 is not None:
+            self.lookup.syn1 = syn1
+        if self.lookup.syn1neg is not None:
+            self.lookup.syn1neg = syn1neg
+        self.doc_vectors = doc
+        return self
+
+    # -- doc vector access ---------------------------------------------------
+
+    def doc_vector(self, label: str) -> np.ndarray:
+        return np.asarray(self.doc_vectors[self._label_index[label]])
+
+    def similarity_to_label(self, text: str, label: str) -> float:
+        v = self.infer_vector(text)
+        d = self.doc_vector(label)
+        denom = np.linalg.norm(v) * np.linalg.norm(d)
+        return float(v @ d / denom) if denom else 0.0
+
+    def nearest_labels(self, text_or_vec, top_n: int = 5):
+        v = (
+            self.infer_vector(text_or_vec)
+            if isinstance(text_or_vec, str) else np.asarray(text_or_vec)
+        )
+        table = np.asarray(self.doc_vectors)
+        sims = (table @ v) / np.maximum(
+            np.linalg.norm(table, axis=1) * (np.linalg.norm(v) + 1e-12), 1e-12
+        )
+        order = np.argsort(-sims)[:top_n]
+        return [(self.labels[i], float(sims[i])) for i in order]
+
+    def infer_vector(self, text: str, steps: int = 5,
+                     learning_rate: Optional[float] = None) -> np.ndarray:
+        """Train a fresh doc vector against FROZEN word tables
+        (reference: ParagraphVectors.inferVector)."""
+        conf = self.conf
+        lr0 = learning_rate if learning_rate is not None else conf.learning_rate
+        tokens = self.tokenizer.create(text).get_tokens()
+        sent = self._index_sentences([tokens])[0]
+        D = conf.layer_size
+        rng = np.random.default_rng(abs(hash(text)) % (2**31))
+        vec = jnp.asarray(
+            (rng.random((1, D), np.float32) - 0.5) / D
+        )
+        if sent.size == 0:
+            return np.asarray(vec[0])
+        plan = BatchPlan(
+            batch_size=max(int(sent.size), 1),
+            context_size=1 if self.sequence_algo == "dbow" else 2 * conf.window,
+            hs_arrays=self.huffman.arrays() if self.huffman else None,
+            negative=conf.negative,
+            unigram=(
+                self.lookup.unigram_table() if conf.negative > 0 else None
+            ),
+            with_doc=True,
+        )
+        if getattr(self, "_infer_step", None) is None:
+            self._infer_step = make_embedding_step(
+                use_hs=conf.use_hierarchic_softmax, negative=conf.negative,
+                with_doc=True, train_words=False, donate=False,
+            )
+        step = self._infer_step
+        dummy = lambda: jnp.zeros((1, D), jnp.float32)
+        syn1 = self.lookup.syn1 if self.lookup.syn1 is not None else dummy()
+        syn1neg = (
+            self.lookup.syn1neg if self.lookup.syn1neg is not None else dummy()
+        )
+        for it in range(steps):
+            lr = lr0 * (1.0 - it / steps)
+            for batch in generate_batches(
+                iter([sent]), plan, window=conf.window,
+                mode=self.sequence_algo, rng=rng, doc_ids=iter([0]),
+            ):
+                _, _, _, vec, _ = step(
+                    self.lookup.syn0, syn1, syn1neg, vec,
+                    {k: jnp.asarray(v) for k, v in batch.items()},
+                    jnp.asarray(lr, jnp.float32),
+                )
+        return np.asarray(vec[0])

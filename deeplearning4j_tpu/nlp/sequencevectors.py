@@ -1,0 +1,219 @@
+"""SequenceVectors — the generic embedding trainer.
+
+Analog of the reference's models/sequencevectors/SequenceVectors.java
+(1,218 LoC): build vocab over a sequence stream, Huffman-code it, then
+train a lookup table with a pluggable learning algorithm. The reference
+spawns VectorCalculationsThread workers that push batched updates into
+native aggregate ops (:285-289); here the host streams fixed-shape
+batches (batching.py) into one jitted device step (learning.py) — the
+thread fan-out is unnecessary because the device consumes batches far
+faster than one host thread produces them.
+
+Learning algorithms (reference: models/embeddings/learning/impl/):
+elements = "skipgram" | "cbow"; sequence (documents) = "dm" | "dbow" are
+driven by ParagraphVectors on top of this trainer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Callable, Iterable, List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nlp.batching import (
+    BatchPlan,
+    generate_batches,
+    group_batches,
+    keep_probabilities,
+    subsample,
+)
+from deeplearning4j_tpu.nlp.learning import make_embedding_scan_step
+from deeplearning4j_tpu.nlp.lookup import InMemoryLookupTable
+from deeplearning4j_tpu.nlp.vocab import Huffman, VocabCache, VocabConstructor
+
+logger = logging.getLogger("deeplearning4j_tpu.nlp")
+
+
+@dataclasses.dataclass
+class VectorsConfiguration:
+    """Hyperparameters (reference: models/embeddings/loader/
+    VectorsConfiguration.java + SequenceVectors.Builder defaults)."""
+
+    layer_size: int = 100
+    window: int = 5
+    min_word_frequency: int = 5
+    iterations: int = 1          # passes per batch stream (reference: iterations)
+    epochs: int = 1
+    learning_rate: float = 0.025
+    min_learning_rate: float = 1e-4
+    negative: int = 0
+    use_hierarchic_softmax: bool = True
+    sampling: float = 0.0        # subsampling threshold t (0 = off)
+    batch_size: int = 2048
+    scan_size: int = 16          # batches per device call (dispatch amortization)
+    seed: int = 12345
+    elements_learning_algorithm: str = "skipgram"  # or "cbow"
+
+
+class SequenceVectors:
+    """Generic trainer over sequences of string elements."""
+
+    def __init__(self, conf: VectorsConfiguration,
+                 sequences: Optional[Iterable[Sequence[str]]] = None,
+                 vocab: Optional[VocabCache] = None):
+        self.conf = conf
+        self._sequences = sequences
+        self.vocab = vocab
+        self.lookup: Optional[InMemoryLookupTable] = None
+        self.huffman: Optional[Huffman] = None
+        self._rng = np.random.default_rng(conf.seed)
+        self._base_key = None  # created lazily (jax init) in train paths
+
+    # -- vocab + table construction ------------------------------------------
+
+    def build_vocab(self):
+        if self.vocab is None:
+            if self._sequences is None:
+                raise ValueError("no sequences to build a vocab from")
+            self.vocab = VocabConstructor(
+                self.conf.min_word_frequency
+            ).build(self._sequences)
+        if self.vocab.num_words() == 0:
+            raise ValueError(
+                "empty vocabulary — lower min_word_frequency or supply "
+                "more data"
+            )
+        if self.conf.use_hierarchic_softmax:
+            self.huffman = Huffman(self.vocab)
+        self.lookup = InMemoryLookupTable(
+            self.vocab, self.conf.layer_size, seed=self.conf.seed,
+            use_hs=self.conf.use_hierarchic_softmax,
+            negative=self.conf.negative,
+        )
+        return self
+
+    # -- training ------------------------------------------------------------
+
+    def _index_sentences(self, sequences) -> List[np.ndarray]:
+        """Token sequences -> vocab-index arrays (unknown words dropped,
+        exactly as the reference skips non-vocab elements)."""
+        by_word = self.vocab._by_word
+        out = []
+        for seq in sequences:
+            idx = [by_word[t].index for t in seq if t in by_word]
+            out.append(np.asarray(idx, np.int64))
+        return out
+
+    def fit(self, sequences: Optional[Iterable[Sequence[str]]] = None):
+        """Build vocab (if needed) and train (reference:
+        SequenceVectors.fit :187)."""
+        seqs = sequences if sequences is not None else self._sequences
+        if self.vocab is None or self.lookup is None:
+            if self.vocab is None:
+                self._sequences = list(seqs)
+                seqs = self._sequences
+            self.build_vocab()
+        indexed = self._index_sentences(seqs)
+        self.train_indexed(indexed)
+        return self
+
+    def train_indexed(self, indexed: List[np.ndarray]):
+        conf = self.conf
+        mode = conf.elements_learning_algorithm
+        if mode not in ("skipgram", "cbow"):
+            raise ValueError(
+                f"unknown elements learning algorithm {mode!r} "
+                "(skipgram | cbow)"
+            )
+        plan = BatchPlan(
+            batch_size=conf.batch_size,
+            context_size=1 if mode == "skipgram" else 2 * conf.window,
+            hs_arrays=self.huffman.arrays() if self.huffman else None,
+            negative=conf.negative,
+            device_negatives=conf.negative > 0,
+            skip_h_mask=mode == "skipgram",
+        )
+        unigram_dev = (
+            jnp.asarray(self.lookup.unigram_table().astype(np.int32))
+            if conf.negative > 0 else jnp.zeros((1,), jnp.int32)
+        )
+        import jax
+
+        if self._base_key is None:
+            self._base_key = jax.random.PRNGKey(conf.seed ^ 0x5EED)
+        # one jitted step per model — recreating it would discard the
+        # compile cache on every train_indexed call
+        if getattr(self, "_scan_step", None) is None:
+            self._scan_step = make_embedding_scan_step(
+                use_hs=conf.use_hierarchic_softmax, negative=conf.negative,
+                with_doc=False,
+            )
+        step = self._scan_step
+        keep = keep_probabilities(self.vocab.counts(), conf.sampling)
+        # distinct placeholder buffers — donation forbids passing the same
+        # array for two donated args
+        dummy = lambda: jnp.zeros((1, conf.layer_size), jnp.float32)
+        syn0, syn1, syn1neg = (
+            self.lookup.syn0,
+            self.lookup.syn1 if self.lookup.syn1 is not None else dummy(),
+            self.lookup.syn1neg if self.lookup.syn1neg is not None else dummy(),
+        )
+        doc = dummy()
+
+        # LR decays linearly over expected EXAMPLES: skip-gram emits about
+        # (window+1) pairs per word (dynamic window E[w]=(window+1)/2,
+        # two sides), cbow one example per word
+        per_word = (conf.window + 1) if mode == "skipgram" else 1
+        total_examples = max(
+            sum(int(s.size) for s in indexed) * conf.epochs
+            * conf.iterations * per_word, 1,
+        )
+        seen = 0
+        loss = None
+        self.last_loss = float("nan")
+        for epoch in range(conf.epochs):
+            sents = [
+                subsample(s, keep, self._rng) for s in indexed
+            ]
+            for _ in range(conf.iterations):
+                for group, lrs, n_rows in group_batches(
+                    generate_batches(
+                        iter(sents), plan, window=conf.window, mode=mode,
+                        rng=self._rng,
+                    ),
+                    plan, conf.scan_size,
+                    lambda s: max(
+                        conf.learning_rate * (1.0 - (seen + s) / total_examples),
+                        conf.min_learning_rate,
+                    ),
+                ):
+                    syn0, syn1, syn1neg, doc, loss = step(
+                        syn0, syn1, syn1neg, doc, unigram_dev, group, lrs,
+                        jax.random.fold_in(self._base_key, seen),
+                    )
+                    seen += n_rows
+            if loss is not None:
+                self.last_loss = float(loss)
+            logger.info("epoch %d done, loss %.4f", epoch, self.last_loss)
+        self.lookup.syn0 = syn0
+        if self.lookup.syn1 is not None:
+            self.lookup.syn1 = syn1
+        if self.lookup.syn1neg is not None:
+            self.lookup.syn1neg = syn1neg
+
+    # -- query API (reference: WordVectors interface) ------------------------
+
+    def word_vector(self, word: str):
+        return self.lookup.vector(word)
+
+    def has_word(self, word: str) -> bool:
+        return self.vocab is not None and self.vocab.contains_word(word)
+
+    def similarity(self, a: str, b: str) -> float:
+        return self.lookup.similarity(a, b)
+
+    def words_nearest(self, word_or_vec, top_n: int = 10):
+        return self.lookup.words_nearest(word_or_vec, top_n)

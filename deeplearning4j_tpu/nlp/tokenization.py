@@ -1,0 +1,118 @@
+"""Tokenization SPI.
+
+Analog of the reference's text/tokenization/ (TokenizerFactory SPI,
+DefaultTokenizerFactory, NGramTokenizerFactory, CommonPreprocessor —
+deeplearning4j-nlp/.../text/tokenization/tokenizerfactory/). Language
+plugins (Japanese Kuromoji, Korean) are out of scope for the core; the SPI
+accepts any callable factory so they can be added the same way.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Callable, List, Optional
+
+
+class TokenPreProcess:
+    """Per-token normalization hook (reference: TokenPreProcess)."""
+
+    def pre_process(self, token: str) -> str:
+        raise NotImplementedError
+
+
+class CommonPreprocessor(TokenPreProcess):
+    """Lowercase + strip punctuation/digits-preserving (reference:
+    text/tokenization/tokenizer/preprocessor/CommonPreprocessor.java)."""
+
+    _PUNCT = re.compile(r"[\d\.:,\"'\(\)\[\]|/?!;]+")
+
+    def pre_process(self, token: str) -> str:
+        return self._PUNCT.sub("", token).lower()
+
+
+class Tokenizer:
+    def __init__(self, tokens: List[str]):
+        self._tokens = tokens
+
+    def get_tokens(self) -> List[str]:
+        return list(self._tokens)
+
+    def count_tokens(self) -> int:
+        return len(self._tokens)
+
+    def __iter__(self):
+        return iter(self._tokens)
+
+
+class TokenizerFactory:
+    """SPI: create(text) -> Tokenizer (reference: TokenizerFactory)."""
+
+    def __init__(self):
+        self._pre: Optional[TokenPreProcess] = None
+
+    def set_token_pre_processor(self, pre: TokenPreProcess) -> "TokenizerFactory":
+        self._pre = pre
+        return self
+
+    def _apply_pre(self, tokens: List[str]) -> List[str]:
+        if self._pre is None:
+            return tokens
+        out = [self._pre.pre_process(t) for t in tokens]
+        return [t for t in out if t]
+
+    def create(self, text: str) -> Tokenizer:
+        raise NotImplementedError
+
+
+class DefaultTokenizerFactory(TokenizerFactory):
+    """Whitespace tokenization (reference: DefaultTokenizerFactory wraps
+    Java's StreamTokenizer; whitespace split is the effective behavior)."""
+
+    def create(self, text: str) -> Tokenizer:
+        return Tokenizer(self._apply_pre(text.split()))
+
+
+class NGramTokenizerFactory(TokenizerFactory):
+    """Emit all n-grams for n in [min_n, max_n] joined by spaces
+    (reference: NGramTokenizerFactory)."""
+
+    def __init__(self, min_n: int = 1, max_n: int = 1):
+        super().__init__()
+        self.min_n = int(min_n)
+        self.max_n = int(max_n)
+
+    def create(self, text: str) -> Tokenizer:
+        base = self._apply_pre(text.split())
+        out: List[str] = []
+        for n in range(self.min_n, self.max_n + 1):
+            for i in range(len(base) - n + 1):
+                out.append(" ".join(base[i : i + n]))
+        return Tokenizer(out)
+
+
+class SentenceIterator:
+    """Stream of sentences/documents (reference: text/sentenceiterator/).
+    Any iterable of strings works; this wrapper adds reset()."""
+
+    def __init__(self, sentences):
+        self._sentences = list(sentences)
+
+    def __iter__(self):
+        return iter(self._sentences)
+
+    def reset(self):
+        pass
+
+
+class LabelAwareSentenceIterator(SentenceIterator):
+    """Sentences with document labels, for ParagraphVectors (reference:
+    text/documentiterator/LabelAwareIterator)."""
+
+    def __init__(self, sentences, labels):
+        super().__init__(sentences)
+        self.labels = list(labels)
+        if len(self.labels) != len(self._sentences):
+            raise ValueError("labels and sentences must align")
+
+    def labeled(self):
+        return zip(self._sentences, self.labels)

@@ -1,0 +1,90 @@
+"""Vocab/Huffman/tokenization unit tests.
+
+Mirrors the reference's NLP test coverage (SURVEY.md §4: 42 test files
+under deeplearning4j-nlp; vocab + Huffman invariants are exercised by
+models/word2vec tests)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp import (
+    CommonPreprocessor,
+    DefaultTokenizerFactory,
+    Huffman,
+    NGramTokenizerFactory,
+    VocabConstructor,
+)
+
+
+def test_tokenizer_and_preprocessor():
+    tf = DefaultTokenizerFactory()
+    assert tf.create("Hello  world").get_tokens() == ["Hello", "world"]
+    tf.set_token_pre_processor(CommonPreprocessor())
+    assert tf.create("Hello, World! 123").get_tokens() == ["hello", "world"]
+    ng = NGramTokenizerFactory(1, 2)
+    toks = ng.create("a b c").get_tokens()
+    assert "a b" in toks and "b c" in toks and "a" in toks
+
+
+def test_vocab_construction_min_frequency():
+    seqs = [["a", "a", "a", "b", "b", "c"]]
+    vocab = VocabConstructor(min_word_frequency=2).build(seqs)
+    assert vocab.contains_word("a") and vocab.contains_word("b")
+    assert not vocab.contains_word("c")
+    # frequency-descending index assignment
+    assert vocab.index_of("a") == 0
+    assert vocab.word_frequency("a") == 3
+    assert vocab.total_word_count == 5
+
+
+def test_huffman_invariants():
+    rng = np.random.default_rng(0)
+    words = [f"w{i}" for i in range(50)]
+    seqs = [
+        list(rng.choice(words, p=_zipf(50), size=200)) for _ in range(20)
+    ]
+    vocab = VocabConstructor(1).build(seqs)
+    h = Huffman(vocab)
+    vws = vocab.vocab_words()
+    V = len(vws)
+    codes = {"".join(map(str, w.code)) for w in vws}
+    assert len(codes) == V  # unique
+    for c1 in codes:  # prefix-free
+        for c2 in codes:
+            if c1 != c2:
+                assert not c2.startswith(c1)
+    for w in vws:
+        assert len(w.code) == len(w.points)
+        assert all(0 <= p <= V - 2 for p in w.points)
+    # more frequent => shorter-or-equal code
+    most = max(vws, key=lambda w: w.count)
+    least = min(vws, key=lambda w: w.count)
+    assert len(most.code) <= len(least.code)
+    # expected code length within 1 bit of the entropy bound
+    counts = vocab.counts().astype(float)
+    p = counts / counts.sum()
+    entropy = -(p * np.log2(p)).sum()
+    avg_len = sum(len(w.code) * w.count for w in vws) / counts.sum()
+    assert entropy <= avg_len <= entropy + 1.0
+    # padded arrays agree with the per-word lists
+    codes_a, points_a, lengths = h.arrays()
+    for i, w in enumerate(vws):
+        n = lengths[i]
+        assert list(codes_a[i, :n]) == w.code
+        assert list(points_a[i, :n]) == w.points
+
+
+def _zipf(n):
+    w = 1.0 / np.arange(1, n + 1)
+    return w / w.sum()
+
+
+def test_unigram_table_distribution():
+    from deeplearning4j_tpu.nlp import InMemoryLookupTable
+
+    vocab = VocabConstructor(1).build([["a"] * 75 + ["b"] * 25])
+    lt = InMemoryLookupTable(vocab, 4, negative=1)
+    table = lt.unigram_table(10_000)
+    frac_a = np.mean(table == vocab.index_of("a"))
+    expected = 75**0.75 / (75**0.75 + 25**0.75)
+    assert abs(frac_a - expected) < 0.02

@@ -1,0 +1,141 @@
+"""Word2Vec / ParagraphVectors / serializer behavior tests.
+
+Mirrors the reference's Word2VecTests / ParagraphVectorsTest strategy
+(small corpora, similarity/ranking sanity — SURVEY.md §4) with a
+deterministic synthetic two-topic corpus instead of raw text files."""
+
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp import (
+    ParagraphVectors,
+    VectorsConfiguration,
+    Word2Vec,
+    WordVectorSerializer,
+)
+
+ANIMALS = ["cat", "dog", "horse", "cow", "sheep"]
+TECH = ["cpu", "gpu", "ram", "disk", "cache"]
+
+
+def _corpus(n=400, seed=0):
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n):
+        group = ANIMALS if rng.random() < 0.5 else TECH
+        out.append(" ".join(rng.choice(group, size=8)))
+    return out
+
+
+def _cluster_check(model):
+    """Nearest neighbors of a word are its topic cluster."""
+    near = [w for w, _ in model.words_nearest("cat", 4)]
+    assert set(near) == set(ANIMALS) - {"cat"}, near
+    assert model.similarity("cat", "dog") > model.similarity("cat", "gpu")
+
+
+def _build(corpus, **kw):
+    b = (
+        Word2Vec.Builder().min_word_frequency(1).layer_size(24)
+        .window_size(4).epochs(10).learning_rate(0.05).seed(7)
+        .batch_size(1024).iterate(corpus)
+    )
+    for k, v in kw.items():
+        getattr(b, k)(v)
+    return b.build()
+
+
+def test_skipgram_hs_learns_clusters():
+    w2v = _build(_corpus(), use_hierarchic_softmax=True, negative_sample=0)
+    w2v.fit()
+    _cluster_check(w2v)
+
+
+def test_skipgram_negative_sampling_learns_clusters():
+    w2v = _build(_corpus(), use_hierarchic_softmax=False, negative_sample=5)
+    w2v.fit()
+    _cluster_check(w2v)
+
+
+def test_cbow_learns_clusters():
+    w2v = _build(
+        _corpus(), use_hierarchic_softmax=True, negative_sample=5,
+        elements_learning_algorithm="cbow",
+    )
+    w2v.fit()
+    _cluster_check(w2v)
+
+
+def test_unknown_word_and_has_word():
+    w2v = _build(_corpus(100), negative_sample=5)
+    w2v.fit()
+    assert w2v.has_word("cat") and not w2v.has_word("zebra")
+    assert w2v.word_vector("zebra") is None
+    assert np.isnan(w2v.similarity("cat", "zebra"))
+
+
+def test_serializer_round_trips(tmp_path):
+    w2v = _build(_corpus(100))
+    w2v.fit()
+    # text
+    p = str(tmp_path / "vecs.txt")
+    WordVectorSerializer.write_word_vectors(w2v, p)
+    back = WordVectorSerializer.read_word_vectors(p)
+    np.testing.assert_allclose(
+        back.word_vector("cat"), w2v.word_vector("cat"), atol=1e-5
+    )
+    # google binary
+    p = str(tmp_path / "vecs.bin")
+    WordVectorSerializer.write_google_binary(w2v, p)
+    back = WordVectorSerializer.read_google_binary(p)
+    assert back.vocab.words() == w2v.vocab.words()
+    np.testing.assert_allclose(
+        back.word_vector("dog"), w2v.word_vector("dog"), atol=1e-6
+    )
+    # full model (resume-capable: tables + counts round-trip)
+    p = str(tmp_path / "full.zip")
+    WordVectorSerializer.write_full_model(w2v, p)
+    full = WordVectorSerializer.read_full_model(p)
+    assert full.vocab.word_frequency("cat") == w2v.vocab.word_frequency("cat")
+    np.testing.assert_allclose(
+        np.asarray(full.lookup.syn1), np.asarray(w2v.lookup.syn1), atol=1e-6
+    )
+    _cluster_check(full)
+
+
+def _pv_conf():
+    return VectorsConfiguration(
+        layer_size=24, min_word_frequency=1, epochs=12, learning_rate=0.05,
+        negative=5, use_hierarchic_softmax=False, window=4, batch_size=256,
+        seed=11,
+    )
+
+
+def _docs(seed=3):
+    rng = np.random.default_rng(seed)
+    docs = [" ".join(rng.choice(ANIMALS, 10)) for _ in range(20)] + [
+        " ".join(rng.choice(TECH, 10)) for _ in range(20)
+    ]
+    return docs, [f"doc_{i}" for i in range(40)]
+
+
+@pytest.mark.parametrize("algo", ["dm", "dbow"])
+def test_paragraph_vectors(algo):
+    docs, labels = _docs()
+    pv = ParagraphVectors(_pv_conf(), docs, labels,
+                          sequence_learning_algorithm=algo)
+    pv.fit()
+    # doc vectors cluster by topic
+    dv = np.asarray(pv.doc_vectors)
+    dvn = dv / np.linalg.norm(dv, axis=1, keepdims=True)
+    sims = dvn @ dvn.T
+    within = (sims[:20, :20].mean() + sims[20:, 20:].mean()) / 2
+    across = sims[:20, 20:].mean()
+    assert within > across + 0.1, (within, across)
+    # inference places an unseen doc in the right cluster
+    v = pv.infer_vector(" ".join(["cat", "dog", "cow"] * 3), steps=10)
+    near = pv.nearest_labels(v, top_n=5)
+    hits = sum(1 for l, _ in near if int(l.split("_")[1]) < 20)
+    assert hits >= 4, near
