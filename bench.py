@@ -170,6 +170,37 @@ def bench_char_lstm(batch=64, seq_len=200, tbptt=50, vocab=77, hidden=200,
     }
 
 
+def bench_vgg16(batch=32, steps=6, image_size=224, classes=1000):
+    """VGG16-via-Keras-import (BASELINE.md workload 5): the conf is built
+    THROUGH the Keras 1.x importer (modelimport/keras.py), then trained on
+    synthetic data — import path + training measured together."""
+    from deeplearning4j_tpu.models.vgg16 import vgg16_conf
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    on_tpu = jax.default_backend() not in ("cpu",)
+    if not on_tpu:
+        batch, steps, image_size, classes = 4, 3, 32, 10
+    conf = vgg16_conf(num_classes=classes, image_size=image_size,
+                      precision="bf16" if on_tpu else "f32")
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(0)
+    x = rng.random((batch, image_size, image_size, 3), np.float32)
+    ds = _device_dataset(x, _onehot(rng, batch, classes))
+    dt, n_steps = _time_fit(net, lambda k: ExistingDataSetIterator([ds] * k), steps)
+    ips = batch * n_steps / dt
+    fwd = mln_forward_flops(conf)
+    step_flops = train_step_flops(fwd, batch)
+    mfu = (step_flops * n_steps / dt) / peak_flops_per_chip() if on_tpu else None
+    return {
+        "value": round(ips, 2),
+        "unit": "images/sec/chip",
+        "batch": batch,
+        "image_size": image_size,
+        "seconds": round(dt, 3),
+        "mfu": None if mfu is None else round(mfu, 4),
+    }
+
+
 def bench_word2vec(vocab=10_000, n_sents=2_000, sent_len=40, batch=8192,
                    layer_size=128, negative=5):
     """Word2Vec skip-gram words/sec (BASELINE.md Word2Vec workload;
@@ -225,6 +256,7 @@ def main():
         ("lenet", bench_lenet),
         ("char_lstm", bench_char_lstm),
         ("word2vec", bench_word2vec),
+        ("vgg16_keras_import", bench_vgg16),
     ):
         try:
             workloads[name] = fn()
