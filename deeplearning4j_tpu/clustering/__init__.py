@@ -1,0 +1,20 @@
+"""Clustering + neighbor-search algorithms (TPU-first).
+
+Capability parity with the reference's deeplearning4j-core clustering
+package (clustering/kmeans, clustering/vptree, clustering/kdtree,
+plot/BarnesHutTsne) — redesigned so the distance work rides the MXU as
+batched matmuls instead of per-point Java loops.
+"""
+
+from deeplearning4j_tpu.clustering.kmeans import ClusterSet, KMeansClustering
+from deeplearning4j_tpu.clustering.kdtree import KDTree
+from deeplearning4j_tpu.clustering.tsne import Tsne
+from deeplearning4j_tpu.clustering.vptree import VPTree
+
+__all__ = [
+    "KMeansClustering",
+    "ClusterSet",
+    "VPTree",
+    "KDTree",
+    "Tsne",
+]

@@ -1,0 +1,62 @@
+"""Batched distance kernels shared by the clustering/neighbor modules.
+
+The reference computes distances point-at-a-time through ND4J accumulations
+(clustering/algorithm/BaseClusteringAlgorithm.java, vptree/VPTree.java
+distance calls). TPU-first, every distance is an [n, m] block computed as
+matmuls: ||x - c||^2 = ||x||^2 + ||c||^2 - 2 x.c rides the MXU, and the
+host only ever sees the reduced results (argmin/top-k).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+EPS = 1e-12
+
+SUPPORTED = ("euclidean", "sqeuclidean", "manhattan", "cosinesimilarity", "dot")
+
+
+def pairwise(x, y, distance: str):
+    """[n, d] x [m, d] -> [n, m] distance/similarity block."""
+    if distance in ("euclidean", "sqeuclidean"):
+        x2 = jnp.sum(x * x, axis=1)[:, None]
+        y2 = jnp.sum(y * y, axis=1)[None, :]
+        d2 = jnp.maximum(x2 + y2 - 2.0 * (x @ y.T), 0.0)
+        return jnp.sqrt(d2) if distance == "euclidean" else d2
+    if distance == "manhattan":
+        # no matmul form; still batched on-device
+        return jnp.sum(jnp.abs(x[:, None, :] - y[None, :, :]), axis=-1)
+    if distance == "cosinesimilarity":
+        xn = x / jnp.sqrt(jnp.sum(x * x, axis=1, keepdims=True) + EPS)
+        yn = y / jnp.sqrt(jnp.sum(y * y, axis=1, keepdims=True) + EPS)
+        return xn @ yn.T
+    if distance == "dot":
+        return x @ y.T
+    raise ValueError(f"unknown distance {distance!r}; supported: {SUPPORTED}")
+
+
+def is_similarity(distance: str) -> bool:
+    """Similarity functions rank DEscending (reference VPTree 'invert')."""
+    return distance in ("cosinesimilarity", "dot")
+
+
+@jax.jit
+def _sq_euclidean(x, y):
+    x2 = jnp.sum(x * x, axis=1)[:, None]
+    y2 = jnp.sum(y * y, axis=1)[None, :]
+    return jnp.maximum(x2 + y2 - 2.0 * (x @ y.T), 0.0)
+
+
+def brute_force_knn(points: np.ndarray, queries: np.ndarray, k: int,
+                    distance: str = "euclidean"):
+    """Exact k-NN of each query against all points — one [q, n] device
+    block + top-k. Returns (indices [q, k], distances [q, k])."""
+    d = pairwise(jnp.asarray(queries), jnp.asarray(points), distance)
+    if is_similarity(distance):
+        vals, idx = jax.lax.top_k(d, k)
+    else:
+        vals, idx = jax.lax.top_k(-d, k)
+        vals = -vals
+    return np.asarray(idx), np.asarray(vals)

@@ -301,8 +301,19 @@ class ComputationGraph(NetworkBase):
             updates, new_upd = updater.apply_tree(grads, upd_state, lr_tree, t)
             new_params = jax.tree_util.tree_map(jnp.add, params, updates)
             merged = self._merge_states(states, new_states)
+            if collect:
+                # per-layer mean |x| scalars for the stats pipeline
+                # (reference: BaseStatsListener mean magnitudes)
+                mm = lambda tree: [
+                    {k: jnp.mean(jnp.abs(v)) for k, v in p.items()}
+                    for p in tree
+                ]
+                stats = {"grad_mm": mm(grads), "update_mm": mm(updates),
+                         "param_mm": mm(new_params)}
+                return new_params, merged, new_upd, score, stats
             return new_params, merged, new_upd, score
 
+        collect = bool(getattr(self, "_collect_stats", False))
         backend = jax.default_backend()
         donate = (0, 2) if backend != "cpu" else ()
         return jax.jit(step, donate_argnums=donate)
@@ -318,13 +329,15 @@ class ComputationGraph(NetworkBase):
         jas = lambda t: None if t is None else [
             None if a is None else jnp.asarray(a) for a in t
         ]
-        params, states, upd, score = self._train_step_fn(
+        out = self._train_step_fn(
             self.params_list, states, self.upd_state,
             [jnp.asarray(x) for x in xs], [jnp.asarray(y) for y in ys],
             jas(f_masks), jas(l_masks),
             jnp.asarray(lr, jnp.float32), jnp.asarray(float(self.iteration)),
             rng,
         )
+        params, states, upd, score = out[:4]
+        self._last_stats = out[4] if len(out) > 4 else None
         self.params_list = params
         self.upd_state = upd
         self._score = score
@@ -462,8 +475,17 @@ class ComputationGraph(NetworkBase):
                 ]
                 updates, new_upd = updater.apply_tree(grads, upd_state, lr_tree, t)
                 new_params = jax.tree_util.tree_map(jnp.add, params, updates)
+                if collect:
+                    mm = lambda tree: [
+                        {k: jnp.mean(jnp.abs(v)) for k, v in p.items()}
+                        for p in tree
+                    ]
+                    stats = {"grad_mm": mm(grads), "update_mm": mm(updates),
+                             "param_mm": mm(new_params)}
+                    return new_params, new_states, new_upd, score, stats
                 return new_params, new_states, new_upd, score
 
+            collect = bool(getattr(self, "_collect_stats", False))
             backend = jax.default_backend()
             donate = (0, 2) if backend != "cpu" else ()
             self._trunc_step_fn = jax.jit(step, donate_argnums=donate)
@@ -479,12 +501,14 @@ class ComputationGraph(NetworkBase):
             [jnp.asarray(x) for x in d[0]], [jnp.asarray(y) for y in d[1]],
             jas(d[2]), jas(d[3]),
         )
-        params, states, upd, score = self._trunc_step_fn(
+        out = self._trunc_step_fn(
             self.params_list, stateful_states, self.upd_state,
             pack(dataA), pack(dataB),
             jnp.asarray(lr, jnp.float32), jnp.asarray(float(self.iteration)),
             rng,
         )
+        params, states, upd, score = out[:4]
+        self._last_stats = out[4] if len(out) > 4 else None
         self.params_list = params
         self.upd_state = upd
         self._score = score

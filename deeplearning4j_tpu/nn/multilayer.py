@@ -308,8 +308,20 @@ class MultiLayerNetwork(NetworkBase):
             updates, new_upd = updater.apply_tree(grads, upd_state, lr_tree, t)
             new_params = jax.tree_util.tree_map(jnp.add, params, updates)
             merged = self._merge_states(states, new_states)
+            if collect:
+                # per-layer mean |x| scalars for the stats pipeline
+                # (reference: BaseStatsListener param/grad/update mean
+                # magnitudes) — fused into the step; tiny reductions
+                mm = lambda tree: [
+                    {k: jnp.mean(jnp.abs(v)) for k, v in p.items()}
+                    for p in tree
+                ]
+                stats = {"grad_mm": mm(grads), "update_mm": mm(updates),
+                         "param_mm": mm(new_params)}
+                return new_params, merged, new_upd, score, stats
             return new_params, merged, new_upd, score
 
+        collect = bool(getattr(self, "_collect_stats", False))
         backend = jax.default_backend()
         donate = (0, 2) if backend != "cpu" else ()
         return jax.jit(step, donate_argnums=donate)
@@ -356,12 +368,14 @@ class MultiLayerNetwork(NetworkBase):
             jax.random.PRNGKey(self.net_conf.seed ^ 0x5EED), self.iteration
         )
         states = stateful_states if stateful_states is not None else self.state_list
-        params, states, upd, score = step_fn(
+        out = step_fn(
             self.params_list, states, self.upd_state,
             tuple(None if a is None else jnp.asarray(a) for a in data),
             jnp.asarray(lr, jnp.float32), jnp.asarray(float(self.iteration)),
             rng,
         )
+        params, states, upd, score = out[:4]
+        self._last_stats = out[4] if len(out) > 4 else None
         self.params_list = params
         self.upd_state = upd
         self._score = score

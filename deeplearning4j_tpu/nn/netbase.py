@@ -39,6 +39,10 @@ class NetworkBase:
         self.upd_state = None
         self._score = None  # last minibatch score (device array, lazy read)
         self._last_etl_ms = 0.0
+        # opt-in per-iteration grad/update/param mean-magnitude collection
+        # for the stats/UI pipeline (reference: BaseStatsListener payloads)
+        self._collect_stats = False
+        self._last_stats = None
         # hook applied to each DataSet before the step — installed by
         # parallel.ParallelWrapper to shard the batch across the mesh
         self._batch_transform = None
@@ -69,6 +73,17 @@ class NetworkBase:
         self.listeners.append(listener)
         return self
 
+    def set_collect_stats(self, flag: bool = True):
+        """Toggle fused per-iteration grad/update/param mean-magnitude
+        collection (used by ui.StatsListener). Rebuilds the train step."""
+        flag = bool(flag)
+        if flag != self._collect_stats:
+            self._collect_stats = flag
+            self._train_step_fn = None
+            if hasattr(self, "_trunc_step_fn"):
+                self._trunc_step_fn = None
+        return self
+
     def _notify(self, batch_size):
         if not self.listeners:
             return
@@ -76,6 +91,7 @@ class NetworkBase:
             "score": lambda: self._score,
             "batch_size": batch_size,
             "etl_ms": self._last_etl_ms,
+            "stats": lambda: self._last_stats,
         }
         for lst in self.listeners:
             lst.iteration_done(self, self.iteration - 1, info)

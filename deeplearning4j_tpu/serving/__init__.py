@@ -1,0 +1,6 @@
+"""Serving entry points: k-NN REST server (reference:
+deeplearning4j-nearestneighbor-server) and ParallelInference (parallel/)."""
+
+from deeplearning4j_tpu.serving.knnserver import NearestNeighborsServer
+
+__all__ = ["NearestNeighborsServer"]

@@ -1,0 +1,135 @@
+"""REST k-NN server over a VPTree (reference:
+nearestneighbor/server/NearestNeighborsServer.java:29,70 — loads a stored
+points NDArray, builds a VPTree with --similarityFunction/--invert, and
+serves POST /knn with {"k": int, "inputIndex": int} ->
+{"results": [{"index": i}, ...]}; DTOs in nearestneighbor/model/).
+
+Extensions beyond the reference API (same shape, additive):
+- POST /knnvector {"k": int, "vector": [floats]} — query by raw vector
+  instead of stored-point index.
+- GET /health — liveness.
+Distances are included in each result row (the reference computes them
+but only returns indices).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.clustering.vptree import VPTree
+
+
+class NearestNeighborsServer:
+    def __init__(self, points: np.ndarray,
+                 similarity_function: str = "euclidean",
+                 invert: bool = False, port: int = 9000):
+        self.points = np.asarray(points, np.float32)
+        self.tree = VPTree(self.points, similarity_function, invert)
+        self.port = int(port)
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # -- request handling ---------------------------------------------------
+
+    def _handle(self, route: str, body: dict) -> tuple:
+        if route == "/knn":
+            k = int(body["k"])
+            idx = int(body["inputIndex"])
+            if not (0 <= idx < self.points.shape[0]):
+                return 400, {"error": f"inputIndex {idx} out of range"}
+            target = self.points[idx]
+        elif route == "/knnvector":
+            k = int(body["k"])
+            target = np.asarray(body["vector"], np.float32)
+            if target.shape != (self.points.shape[1],):
+                return 400, {
+                    "error": f"vector must have dim {self.points.shape[1]}"
+                }
+        else:
+            return 404, {"error": f"no route {route}"}
+        indices, distances = self.tree.search(target, k)
+        return 200, {
+            "results": [
+                {"index": int(i), "distance": float(d)}
+                for i, d in zip(indices, distances)
+            ]
+        }
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> int:
+        """Start serving on a background thread; returns the bound port
+        (useful with port=0 for tests)."""
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _send(self, code: int, payload: dict):
+                data = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                if self.path == "/health":
+                    self._send(200, {"status": "ok",
+                                     "points": outer.points.shape[0]})
+                else:
+                    self._send(404, {"error": "not found"})
+
+            def do_POST(self):
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    body = json.loads(self.rfile.read(n) or b"{}")
+                    code, payload = outer._handle(self.path, body)
+                except (ValueError, KeyError, TypeError) as e:
+                    code, payload = 400, {"error": str(e)}
+                self._send(code, payload)
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self.port
+
+    def stop(self):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+
+
+def main(argv=None):
+    """CLI matching the reference's flags (NearestNeighborsServer.java):
+    --ndarrayPath (a .npy file), --nearestNeighborsPort,
+    --similarityFunction, --invert."""
+    ap = argparse.ArgumentParser(description="k-NN REST server")
+    ap.add_argument("--ndarrayPath", required=True)
+    ap.add_argument("--nearestNeighborsPort", type=int, default=9000)
+    ap.add_argument("--similarityFunction", default="euclidean")
+    ap.add_argument("--invert", action="store_true")
+    args = ap.parse_args(argv)
+    points = np.load(args.ndarrayPath)
+    server = NearestNeighborsServer(points, args.similarityFunction,
+                                    args.invert, args.nearestNeighborsPort)
+    port = server.start()
+    print(f"nearest-neighbors server listening on :{port}")
+    try:
+        server._thread.join()
+    except KeyboardInterrupt:
+        server.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,29 @@
+"""Observability pipeline (reference: deeplearning4j-ui-parent, ~30k LoC).
+
+Capability map:
+- StatsListener (ui/stats.py)       <- BaseStatsListener.java:51,103-124
+- storage SPI + impls (ui/storage.py) <- api/storage/StatsStorage.java,
+  InMemoryStatsStorage / FileStatsStorage (MapDB/sqlite variants collapse
+  into the file store — mechanism, not engine, is the capability)
+- compact wire codec (ui/codec.py)  <- SBE-generated codecs (ui/stats/sbe/)
+- dashboard server (ui/server.py)   <- PlayUIServer + TrainModule routes
+  (/train/overview, /train/model, /train/system) + RemoteReceiverModule
+"""
+
+from deeplearning4j_tpu.ui.stats import StatsListener
+from deeplearning4j_tpu.ui.storage import (
+    FileStatsStorage,
+    InMemoryStatsStorage,
+    RemoteUIStatsStorageRouter,
+    StatsStorage,
+)
+from deeplearning4j_tpu.ui.server import UIServer
+
+__all__ = [
+    "StatsListener",
+    "StatsStorage",
+    "InMemoryStatsStorage",
+    "FileStatsStorage",
+    "RemoteUIStatsStorageRouter",
+    "UIServer",
+]

@@ -1,0 +1,269 @@
+"""Training dashboard HTTP server.
+
+Reference: PlayUIServer (deeplearning4j-play, runnable with --uiPort) +
+TrainModule route table (module/train/TrainModule.java:96-112):
+/train -> overview, /train/overview(/data), /train/model(/graph,
+/data/:layerId), /train/system(/data), /train/sessions/current|all; the
+RemoteReceiverModule accepts stats POSTed from remote training processes.
+
+Self-contained stdlib implementation: JSON data routes consumed by an
+inline HTML/SVG dashboard (no external assets — the box it runs on may
+have zero egress), polling /train/overview/data every 2s.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import urlparse
+
+from deeplearning4j_tpu.ui.codec import decode_record
+from deeplearning4j_tpu.ui.storage import StatsStorage
+
+
+_PAGE = """<!doctype html>
+<html><head><title>dl4j-tpu training UI</title>
+<style>
+ body {{ font-family: sans-serif; margin: 1.5em; background: #fafafa; }}
+ h1 {{ font-size: 1.2em; }} h2 {{ font-size: 1em; color: #444; }}
+ .chart {{ background: #fff; border: 1px solid #ddd; margin: 0.6em;
+           padding: 0.4em; display: inline-block; }}
+ nav a {{ margin-right: 1.2em; }}
+ table {{ border-collapse: collapse; }} td, th {{ border: 1px solid #ccc;
+   padding: 2px 8px; font-size: 0.85em; }}
+</style></head>
+<body>
+<nav><a href="/train/overview">overview</a><a href="/train/model">model</a>
+<a href="/train/system">system</a></nav>
+<h1>dl4j-tpu training — {title}</h1>
+<div id="content">loading…</div>
+<script>
+const VIEW = "{view}";
+function line(points, w, h, color) {{
+  if (points.length < 2) return "<svg width="+w+" height="+h+"></svg>";
+  const xs = points.map(p => p[0]), ys = points.map(p => p[1]);
+  const x0 = Math.min(...xs), x1 = Math.max(...xs);
+  const y0 = Math.min(...ys), y1 = Math.max(...ys);
+  const sx = x => 4 + (w-8) * (x - x0) / Math.max(x1 - x0, 1e-9);
+  const sy = y => h - 4 - (h-8) * (y - y0) / Math.max(y1 - y0, 1e-9);
+  const d = points.map((p,i) => (i?"L":"M") + sx(p[0]).toFixed(1) + "," +
+                                sy(p[1]).toFixed(1)).join(" ");
+  return `<svg width=${{w}} height=${{h}}><path d="${{d}}" fill="none"
+          stroke="${{color}}" stroke-width="1.5"/></svg>
+          <div style="font-size:0.7em;color:#888">min ${{y0.toPrecision(4)}}
+          max ${{y1.toPrecision(4)}}</div>`;
+}}
+function chart(title, pts, color) {{
+  return `<div class="chart"><h2>${{title}}</h2>${{line(pts,380,160,color)}}</div>`;
+}}
+async function refresh() {{
+  const r = await fetch("/train/" + VIEW + "/data");
+  const d = await r.json();
+  let html = "";
+  if (VIEW == "overview") {{
+    html += chart("score vs iteration", d.score, "#1565c0");
+    html += chart("samples/sec", d.samples_per_sec, "#2e7d32");
+    html += chart("update:param ratio (log10)", d.update_ratio, "#c62828");
+    html += chart("etl ms", d.etl_ms, "#6a1b9a");
+  }} else if (VIEW == "model") {{
+    for (const layer of d.layers) {{
+      html += `<h2>layer ${{layer.index}} — ${{layer.type}}
+               (${{layer.n_params}} params)</h2>`;
+      for (const [name, pts] of Object.entries(layer.series))
+        html += chart(name, pts, "#00695c");
+    }}
+  }} else {{
+    html += "<table><tr><th>key</th><th>value</th></tr>";
+    for (const [k,v] of Object.entries(d.static || {{}}))
+      html += `<tr><td>${{k}}</td><td>${{JSON.stringify(v)}}</td></tr>`;
+    html += "</table>";
+    for (const [dev, pts] of Object.entries(d.memory || {{}}))
+      html += chart(dev + " bytes in use", pts, "#ef6c00");
+  }}
+  document.getElementById("content").innerHTML = html;
+}}
+refresh(); setInterval(refresh, 2000);
+</script></body></html>"""
+
+
+class UIServer:
+    """UIServer(storage, port=9090).start() -> bound port."""
+
+    _instance = None
+
+    def __init__(self, storage: StatsStorage, port: int = 9090):
+        self.storage = storage
+        self.port = int(port)
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    @classmethod
+    def get_instance(cls, storage: Optional[StatsStorage] = None,
+                     port: int = 9090) -> "UIServer":
+        """Singleton accessor (reference: UIServer.getInstance())."""
+        if cls._instance is None:
+            from deeplearning4j_tpu.ui.storage import InMemoryStatsStorage
+
+            cls._instance = cls(storage or InMemoryStatsStorage(), port)
+            cls._instance.start()
+        return cls._instance
+
+    # -- data assembly -------------------------------------------------------
+
+    def _current_session(self) -> Optional[str]:
+        """Most recently ACTIVE session (latest update/static timestamp),
+        not lexicographic order — random session-id suffixes don't sort
+        by age."""
+        ids = self.storage.list_session_ids()
+        if not ids:
+            return None
+
+        def last_ts(sid):
+            ups = self.storage.get_updates(sid)
+            if ups:
+                return ups[-1].get("ts", 0.0)
+            st = self.storage.get_static_info(sid) or {}
+            return st.get("start_time", 0.0)
+
+        return max(ids, key=last_ts)
+
+    def _overview_data(self, session: Optional[str]) -> dict:
+        ups = self.storage.get_updates(session) if session else []
+        import math
+
+        def ratio(u):
+            um, pm = u.get("update_mm"), u.get("param_mm")
+            if not um or not pm:
+                return None
+            us = sum(um.values()) / max(len(um), 1)
+            ps = sum(pm.values()) / max(len(pm), 1)
+            if us <= 0 or ps <= 0:
+                return None
+            return math.log10(us / ps)
+
+        return {
+            "session": session,
+            "score": [[u["iteration"], u["score"]] for u in ups],
+            "samples_per_sec": [
+                [u["iteration"], u["samples_per_sec"]] for u in ups],
+            "etl_ms": [[u["iteration"], u["etl_ms"]] for u in ups],
+            "update_ratio": [
+                [u["iteration"], r] for u in ups
+                if (r := ratio(u)) is not None],
+        }
+
+    def _model_data(self, session: Optional[str]) -> dict:
+        ups = self.storage.get_updates(session) if session else []
+        static = (self.storage.get_static_info(session) or {}) if session else {}
+        layers = []
+        for meta in static.get("layers", []):
+            li = meta["index"]
+            series = {}
+            for group, label in (("grad_mm", "grad"), ("update_mm", "update"),
+                                 ("param_mm", "param")):
+                for u in ups:
+                    g = u.get(group) or {}
+                    for k, v in g.items():
+                        if k.startswith(f"{li}_"):
+                            series.setdefault(
+                                f"{label} |{k[len(str(li)) + 1:]}|", []
+                            ).append([u["iteration"], v])
+            layers.append({**meta, "series": series})
+        return {"session": session, "layers": layers}
+
+    def _system_data(self, session: Optional[str]) -> dict:
+        ups = self.storage.get_updates(session) if session else []
+        static = (self.storage.get_static_info(session) or {}) if session else {}
+        memory = {}
+        for u in ups:
+            for dev, m in (u.get("memory") or {}).items():
+                memory.setdefault(dev, []).append(
+                    [u["iteration"], m.get("bytes_in_use", 0)])
+        return {"session": session, "static": static, "memory": memory}
+
+    # -- http ----------------------------------------------------------------
+
+    def start(self) -> int:
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _send(self, code, body: bytes, ctype="application/json"):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _json(self, obj, code=200):
+                self._send(code, json.dumps(obj).encode())
+
+            def do_GET(self):
+                path = urlparse(self.path).path.rstrip("/") or "/train/overview"
+                session = outer._current_session()
+                if path in ("/train", "/train/overview"):
+                    self._send(200, _PAGE.format(
+                        title="overview", view="overview").encode(),
+                        "text/html")
+                elif path == "/train/model":
+                    self._send(200, _PAGE.format(
+                        title="model", view="model").encode(), "text/html")
+                elif path == "/train/system":
+                    self._send(200, _PAGE.format(
+                        title="system", view="system").encode(), "text/html")
+                elif path == "/train/overview/data":
+                    self._json(outer._overview_data(session))
+                elif path == "/train/model/data":
+                    self._json(outer._model_data(session))
+                elif path == "/train/model/graph":
+                    st = (outer.storage.get_static_info(session) or {}
+                          ) if session else {}
+                    self._json({"layers": st.get("layers", [])})
+                elif path == "/train/system/data":
+                    self._json(outer._system_data(session))
+                elif path == "/train/sessions/current":
+                    self._json({"session": session})
+                elif path == "/train/sessions/all":
+                    self._json({"sessions": outer.storage.list_session_ids()})
+                else:
+                    self._json({"error": f"no route {path}"}, 404)
+
+            def do_POST(self):
+                # remote receiver (reference: RemoteReceiverModule)
+                n = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(n)
+                session = self.headers.get("X-Session-Id", "remote")
+                path = urlparse(self.path).path
+                try:
+                    if path == "/remote/static":
+                        outer.storage.put_static_info(
+                            session, json.loads(body))
+                    elif path == "/remote/update":
+                        outer.storage.put_update(
+                            session, decode_record(body))
+                    else:
+                        return self._json({"error": "bad route"}, 404)
+                    self._json({"status": "ok"})
+                except (ValueError, KeyError, IndexError,
+                        struct.error) as e:
+                    self._json({"error": str(e)}, 400)
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+        return self.port
+
+    def stop(self):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if UIServer._instance is self:
+            UIServer._instance = None

@@ -1,0 +1,131 @@
+"""StatsListener: per-iteration training telemetry -> storage router.
+
+Reference: BaseStatsListener.java:51,103-124 — collects score,
+param/gradient/update mean magnitudes, learning rate, memory and
+throughput counters each iteration and routes them through a
+StatsStorageRouter; cadence controlled by StatsUpdateConfiguration.
+
+TPU-first: the mean-magnitude reductions are fused INTO the jitted train
+step (net.set_collect_stats(True) — netbase exposes them via
+info["stats"]) so collection adds tiny on-device reductions instead of
+host-side parameter sweeps; the host readback happens only every
+``frequency`` iterations.
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from typing import Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.train.listeners import IterationListener
+from deeplearning4j_tpu.ui.storage import StatsStorageRouter
+
+
+def _device_memory_stats() -> dict:
+    """Per-device memory counters when the backend exposes them (TPU/GPU
+    runtimes do; CPU returns nothing). Reference reports JVM/off-heap
+    memory per device (BaseStatsListener memory section)."""
+    import jax
+
+    out = {}
+    try:
+        for d in jax.local_devices():
+            ms = d.memory_stats()
+            if ms:
+                out[f"device{d.id}"] = {
+                    "bytes_in_use": int(ms.get("bytes_in_use", 0)),
+                    "bytes_limit": int(ms.get("bytes_limit", 0)),
+                }
+    except Exception:
+        pass
+    return out
+
+
+class StatsListener(IterationListener):
+    """Routes per-iteration stats to a StatsStorageRouter.
+
+    Usage::
+
+        storage = InMemoryStatsStorage()
+        net.set_collect_stats(True)
+        net.set_listeners(StatsListener(storage))
+        net.fit(...)
+        UIServer(storage).start()
+    """
+
+    def __init__(self, router: StatsStorageRouter,
+                 session_id: Optional[str] = None,
+                 worker_id: str = "worker0",
+                 frequency: int = 1,
+                 report_memory: bool = True):
+        self.router = router
+        self.session_id = session_id or f"session-{uuid.uuid4().hex[:8]}"
+        self.worker_id = worker_id
+        self.frequency = max(1, int(frequency))
+        self.report_memory = report_memory
+        self._sent_static = False
+        self._last_time: Optional[float] = None
+        self._samples_since = 0
+
+    # -- static info (once per session) --------------------------------------
+
+    def _send_static(self, model):
+        import jax
+
+        confs = model._ordered_layer_confs()
+        layers = [
+            {"index": i, "type": type(c).__name__,
+             "n_params": int(sum(np.prod(v.shape) for v in p.values()))}
+            for i, (c, p) in enumerate(zip(confs, model.params_list))
+        ]
+        self.router.put_static_info(self.session_id, {
+            "model_class": type(model).__name__,
+            "backend": jax.default_backend(),
+            "device": str(jax.devices()[0].device_kind),
+            "n_devices": len(jax.devices()),
+            "start_time": time.time(),
+            "layers": layers,
+            "total_params": int(sum(l["n_params"] for l in layers)),
+        })
+        self._sent_static = True
+
+    # -- per iteration --------------------------------------------------------
+
+    def iteration_done(self, model, iteration, info):
+        if not self._sent_static:
+            self._send_static(model)
+        now = time.perf_counter()
+        self._samples_since += info.get("batch_size", 0)
+        if iteration % self.frequency != 0:
+            return
+        sps = 0.0
+        if self._last_time is not None and now > self._last_time:
+            sps = self._samples_since / (now - self._last_time)
+        self._last_time = now
+        self._samples_since = 0
+
+        rec = {
+            "iteration": int(iteration),
+            "ts": time.time(),
+            "epoch": int(model.epoch),
+            "score": float(np.asarray(info["score"]())),
+            "etl_ms": float(info.get("etl_ms", 0.0)),
+            "samples_per_sec": float(sps),
+            "worker": 0,
+        }
+        stats = info.get("stats", lambda: None)()
+        if stats is not None:
+            for group in ("grad_mm", "update_mm", "param_mm"):
+                per_layer = {}
+                for li, layer in enumerate(stats[group]):
+                    for pname, v in layer.items():
+                        per_layer[f"{li}_{pname}"] = float(np.asarray(v))
+                rec[group] = per_layer
+        if self.report_memory:
+            mem = _device_memory_stats()
+            if mem:
+                rec["memory"] = mem
+        self.router.put_update(self.session_id, rec)

@@ -1,0 +1,221 @@
+"""Stats storage SPI + implementations.
+
+Reference contract: api/storage/StatsStorage.java (sessions -> static
+info + ordered updates, with attachable listeners notified on new
+records) and the StatsStorageRouter producer side. Impls here:
+
+- InMemoryStatsStorage — dict-backed (reference: InMemoryStatsStorage)
+- FileStatsStorage     — append-only log of binary records (codec.py),
+  readable cold (reference: FileStatsStorage/MapDB/J7File collapse into
+  this one mechanism)
+- RemoteUIStatsStorageRouter — HTTP POST producer for a remote UI server
+  (reference: RemoteReceiverModule + remote-iterationlisteners)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import struct
+import threading
+import urllib.request
+from typing import Callable, Dict, List, Optional
+
+from deeplearning4j_tpu.ui.codec import decode_record, encode_record
+
+
+class StatsStorageRouter:
+    """Producer-side SPI (reference: api/storage/StatsStorageRouter.java)."""
+
+    def put_static_info(self, session_id: str, info: dict) -> None:
+        raise NotImplementedError
+
+    def put_update(self, session_id: str, record: dict) -> None:
+        raise NotImplementedError
+
+
+class StatsStorage(StatsStorageRouter):
+    """Reader-side SPI (reference: api/storage/StatsStorage.java)."""
+
+    def list_session_ids(self) -> List[str]:
+        raise NotImplementedError
+
+    def get_static_info(self, session_id: str) -> Optional[dict]:
+        raise NotImplementedError
+
+    def get_updates(self, session_id: str,
+                    since_iteration: int = -1) -> List[dict]:
+        raise NotImplementedError
+
+    # listener routing (reference: StatsStorageListener)
+    def register_listener(self, fn: Callable[[str, dict], None]) -> None:
+        if not hasattr(self, "_listeners"):
+            self._listeners = []
+        self._listeners.append(fn)
+
+    def _notify(self, session_id: str, record: dict) -> None:
+        for fn in getattr(self, "_listeners", []):
+            fn(session_id, record)
+
+
+class InMemoryStatsStorage(StatsStorage):
+    def __init__(self):
+        self._static: Dict[str, dict] = {}
+        self._updates: Dict[str, List[dict]] = {}
+        self._lock = threading.Lock()
+
+    def put_static_info(self, session_id, info):
+        with self._lock:
+            self._static[session_id] = dict(info)
+            self._updates.setdefault(session_id, [])
+
+    def put_update(self, session_id, record):
+        with self._lock:
+            self._updates.setdefault(session_id, []).append(dict(record))
+        self._notify(session_id, record)
+
+    def list_session_ids(self):
+        with self._lock:
+            return sorted(set(self._static) | set(self._updates))
+
+    def get_static_info(self, session_id):
+        with self._lock:
+            return self._static.get(session_id)
+
+    def get_updates(self, session_id, since_iteration=-1):
+        with self._lock:
+            ups = list(self._updates.get(session_id, []))
+        return [u for u in ups if u.get("iteration", 0) > since_iteration]
+
+
+class FileStatsStorage(StatsStorage):
+    """Append-only log: [u8 kind][u16 session_len][session utf8]
+    [u32 payload_len][payload] where kind 0 = static JSON, 1 = binary
+    update record. Cold-readable — open an existing path to browse a
+    finished run (the dashboard does exactly this)."""
+
+    _KIND_STATIC = 0
+    _KIND_UPDATE = 1
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        self._static: Dict[str, dict] = {}
+        self._updates: Dict[str, List[dict]] = {}
+        if os.path.exists(path):
+            self._load()
+        else:
+            open(path, "wb").close()
+
+    def _load(self):
+        with open(self.path, "rb") as f:
+            data = f.read()
+        off = 0
+        while off < len(data):
+            kind = data[off]
+            off += 1
+            (slen,) = struct.unpack_from("<H", data, off)
+            off += 2
+            session = data[off:off + slen].decode()
+            off += slen
+            (plen,) = struct.unpack_from("<I", data, off)
+            off += 4
+            payload = data[off:off + plen]
+            off += plen
+            if kind == self._KIND_STATIC:
+                self._static[session] = json.loads(payload)
+            else:
+                self._updates.setdefault(session, []).append(
+                    decode_record(payload))
+
+    def _append(self, kind: int, session_id: str, payload: bytes):
+        sb = session_id.encode()
+        with open(self.path, "ab") as f:
+            f.write(bytes([kind]) + struct.pack("<H", len(sb)) + sb
+                    + struct.pack("<I", len(payload)) + payload)
+
+    def put_static_info(self, session_id, info):
+        with self._lock:
+            self._static[session_id] = dict(info)
+            self._append(self._KIND_STATIC, session_id,
+                         json.dumps(info).encode())
+
+    def put_update(self, session_id, record):
+        encoded = encode_record(record)
+        with self._lock:
+            self._updates.setdefault(session_id, []).append(
+                decode_record(encoded))
+            self._append(self._KIND_UPDATE, session_id, encoded)
+        self._notify(session_id, record)
+
+    def list_session_ids(self):
+        with self._lock:
+            return sorted(set(self._static) | set(self._updates))
+
+    def get_static_info(self, session_id):
+        with self._lock:
+            return self._static.get(session_id)
+
+    def get_updates(self, session_id, since_iteration=-1):
+        with self._lock:
+            ups = list(self._updates.get(session_id, []))
+        return [u for u in ups if u.get("iteration", 0) > since_iteration]
+
+
+class RemoteUIStatsStorageRouter(StatsStorageRouter):
+    """POSTs records to a UIServer's /remote endpoint (reference:
+    RemoteUIStatsStorageRouter + RemoteReceiverModule). Fire-and-forget:
+    records go through a bounded queue drained by a daemon thread, so a
+    slow or dead dashboard never blocks the training loop — when the
+    queue is full the OLDEST record is dropped."""
+
+    def __init__(self, url: str, timeout: float = 2.0,
+                 queue_size: int = 256):
+        self.url = url.rstrip("/")
+        self.timeout = timeout
+        self._q: "queue.Queue[tuple]" = queue.Queue(maxsize=queue_size)
+        self._worker = threading.Thread(target=self._drain, daemon=True)
+        self._worker.start()
+
+    def _drain(self):
+        while True:
+            route, session_id, body, ctype = self._q.get()
+            req = urllib.request.Request(
+                f"{self.url}{route}", data=body,
+                headers={"Content-Type": ctype,
+                         "X-Session-Id": session_id})
+            try:
+                urllib.request.urlopen(req, timeout=self.timeout).read()
+            except OSError:
+                pass  # dashboard unreachable — drop the record
+            finally:
+                self._q.task_done()
+
+    def _enqueue(self, item):
+        while True:
+            try:
+                self._q.put_nowait(item)
+                return
+            except queue.Full:
+                try:
+                    self._q.get_nowait()  # shed the oldest
+                    self._q.task_done()
+                except queue.Empty:
+                    pass
+
+    def flush(self, timeout: float = 10.0):
+        """Block until queued records are posted (tests / end of run)."""
+        import time as _time
+
+        deadline = _time.monotonic() + timeout
+        while not self._q.empty() and _time.monotonic() < deadline:
+            _time.sleep(0.02)
+
+    def put_static_info(self, session_id, info):
+        self._enqueue(("/remote/static", session_id,
+                       json.dumps(info).encode(), "application/json"))
+
+    def put_update(self, session_id, record):
+        self._enqueue(("/remote/update", session_id,
+                       encode_record(record), "application/octet-stream"))

@@ -1,0 +1,181 @@
+"""Clustering stack: k-means, VPTree, KDTree, t-SNE, k-NN server.
+
+Mirrors the reference's test approach (deeplearning4j-core clustering
+tests): correctness vs brute force on random data, convergence on
+separable blobs.
+"""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.clustering import KDTree, KMeansClustering, Tsne, VPTree
+from deeplearning4j_tpu.clustering.distances import brute_force_knn
+from deeplearning4j_tpu.serving.knnserver import NearestNeighborsServer
+
+
+def _blobs(n_per=60, centers=((0, 0, 0), (8, 8, 8), (-8, 8, -8)), seed=0):
+    rng = np.random.default_rng(seed)
+    xs, ys = [], []
+    for ci, c in enumerate(centers):
+        xs.append(rng.normal(loc=c, scale=1.0, size=(n_per, len(c))))
+        ys.append(np.full(n_per, ci))
+    return np.concatenate(xs).astype(np.float32), np.concatenate(ys)
+
+
+# -- k-means -----------------------------------------------------------------
+
+def test_kmeans_recovers_blobs():
+    x, y = _blobs()
+    cs = KMeansClustering.setup(3, 50, "euclidean", seed=3).apply_to(x)
+    # each true blob maps to exactly one cluster
+    mapping = {}
+    for ci in range(3):
+        assigned = cs.assignments[y == ci]
+        top = np.bincount(assigned, minlength=3).argmax()
+        assert np.mean(assigned == top) > 0.95
+        mapping[ci] = top
+    assert len(set(mapping.values())) == 3
+    assert cs.iterations <= 50
+    assert len(cs.clusters) == 3
+    assert sum(c.count for c in cs.clusters) == x.shape[0]
+
+
+def test_kmeans_cosine_spherical():
+    """cosinesimilarity k-means clusters by direction, not magnitude."""
+    rng = np.random.default_rng(7)
+    dirs = np.array([[1.0, 0.0], [0.0, 1.0], [-1.0, 0.0]])
+    x = np.concatenate([
+        d[None, :] * rng.uniform(0.5, 5.0, (40, 1))
+        + 0.02 * rng.standard_normal((40, 2))
+        for d in dirs
+    ]).astype(np.float32)
+    cs = KMeansClustering.setup(
+        3, 50, "cosinesimilarity", seed=1).apply_to(x)
+    labels = np.repeat(np.arange(3), 40)
+    for ci in range(3):
+        assigned = cs.assignments[labels == ci]
+        assert np.mean(assigned == np.bincount(
+            assigned, minlength=3).argmax()) > 0.95
+    # centers are unit-normalized (spherical k-means)
+    np.testing.assert_allclose(
+        np.linalg.norm(cs.centers, axis=1), 1.0, atol=1e-5)
+    with pytest.raises(ValueError):
+        KMeansClustering(3, distance_function="dot")
+
+
+def test_vptree_invert_flips_ranking():
+    rng = np.random.default_rng(8)
+    pts = rng.standard_normal((200, 4)).astype(np.float32)
+    near = VPTree(pts, "euclidean").search(pts[0], 200)[0]
+    far = VPTree(pts, "euclidean", invert=True).search(pts[0], 200)[0]
+    assert near[0] == 0 and far[-1] == 0
+    assert list(near) == list(far[::-1])
+
+
+def test_kmeans_nearest_cluster_and_validation():
+    x, _ = _blobs(n_per=20)
+    cs = KMeansClustering.setup(3, 30).apply_to(x)
+    c = cs.nearest_cluster(x[0])
+    assert c == cs.assignments[0]
+    with pytest.raises(ValueError):
+        KMeansClustering.setup(99, 5).apply_to(x[:10])
+    with pytest.raises(ValueError):
+        KMeansClustering(3, distance_function="nope")
+
+
+# -- trees vs brute force ----------------------------------------------------
+
+@pytest.mark.parametrize("distance", ["euclidean", "manhattan",
+                                      "cosinesimilarity", "dot"])
+def test_vptree_matches_brute_force(distance):
+    rng = np.random.default_rng(1)
+    # above the brute_force_threshold so the tree path is exercised
+    pts = rng.standard_normal((3000, 16)).astype(np.float32)
+    tree = VPTree(pts, distance, brute_force_threshold=100)
+    for qi in (0, 57, 2999):
+        idx, dist = tree.search(pts[qi], 10)
+        bidx, bdist = brute_force_knn(pts, pts[qi][None, :], 10, distance)
+        # atol covers the f32 cancellation in the matmul distance form
+        # (||x||^2 + ||y||^2 - 2xy): sqrt of ~eps*||x||^2 is ~1e-3
+        np.testing.assert_allclose(
+            np.sort(dist), np.sort(bdist[0]), rtol=2e-4, atol=5e-3)
+        if distance != "dot":  # under dot, self is not necessarily top-1
+            assert idx[0] == qi  # the point itself is its own 1-NN
+
+
+def test_vptree_brute_path_small_set():
+    rng = np.random.default_rng(2)
+    pts = rng.standard_normal((100, 8)).astype(np.float32)
+    tree = VPTree(pts, "euclidean")  # below threshold -> flat device path
+    assert tree.brute
+    idx, dist = tree.search(pts[5], 4)
+    bidx, _ = brute_force_knn(pts, pts[5][None, :], 4, "euclidean")
+    assert set(idx.tolist()) == set(bidx[0].tolist())
+
+
+def test_kdtree_matches_brute_force():
+    rng = np.random.default_rng(3)
+    pts = rng.standard_normal((2000, 3)).astype(np.float32)
+    tree = KDTree(pts)
+    for qi in (1, 500, 1999):
+        idx, dist = tree.knn(pts[qi], 8)
+        bidx, bdist = brute_force_knn(pts, pts[qi][None, :], 8, "euclidean")
+        np.testing.assert_allclose(np.sort(dist), np.sort(bdist[0]),
+                                   rtol=1e-4, atol=1e-5)
+
+
+# -- t-SNE -------------------------------------------------------------------
+
+def test_tsne_separates_blobs():
+    x, y = _blobs(n_per=40)
+    ts = Tsne(perplexity=15, n_iter=500, stop_lying_iteration=100,
+              momentum_switch_iteration=100, seed=4)
+    emb = ts.fit_transform(x)
+    assert emb.shape == (x.shape[0], 2)
+    assert np.isfinite(emb).all()
+    assert np.isfinite(ts.kl_)
+    # blob centroids in embedding space separate from their spreads
+    cents = np.stack([emb[y == c].mean(axis=0) for c in range(3)])
+    spread = max(float(emb[y == c].std()) for c in range(3))
+    min_sep = min(
+        float(np.linalg.norm(cents[i] - cents[j]))
+        for i in range(3) for j in range(i + 1, 3))
+    assert min_sep > 2.0 * spread
+
+
+# -- k-NN server -------------------------------------------------------------
+
+def _post(port, route, payload):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{route}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req) as r:
+        return json.loads(r.read())
+
+
+def test_knn_server_round_trip():
+    rng = np.random.default_rng(5)
+    pts = rng.standard_normal((300, 8)).astype(np.float32)
+    server = NearestNeighborsServer(pts, port=0)
+    port = server.start()
+    try:
+        out = _post(port, "/knn", {"k": 5, "inputIndex": 17})
+        got = [r["index"] for r in out["results"]]
+        bidx, _ = brute_force_knn(pts, pts[17][None, :], 5, "euclidean")
+        assert set(got) == set(bidx[0].tolist())
+        assert got[0] == 17
+
+        out = _post(port, "/knnvector",
+                    {"k": 3, "vector": pts[42].tolist()})
+        assert out["results"][0]["index"] == 42
+
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/health") as r:
+            health = json.loads(r.read())
+        assert health["points"] == 300
+    finally:
+        server.stop()

@@ -1,0 +1,135 @@
+"""Observability pipeline: codec, storage, StatsListener, UIServer.
+
+Mirrors the reference's UI tests (TestStatsStorage + TrainModule route
+coverage): train a small net with a StatsListener, assert the storage
+holds real per-iteration records, serve them over the dashboard routes.
+"""
+
+import json
+import urllib.request
+
+import numpy as np
+
+from deeplearning4j_tpu.ui import (
+    FileStatsStorage,
+    InMemoryStatsStorage,
+    RemoteUIStatsStorageRouter,
+    StatsListener,
+    UIServer,
+)
+from deeplearning4j_tpu.ui.codec import decode_record, encode_record
+
+
+def _train_with_listener(storage, n_iters=6):
+    from deeplearning4j_tpu.models.lenet import lenet_conf
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    net = MultiLayerNetwork(lenet_conf()).init()
+    net.set_collect_stats(True)
+    listener = StatsListener(storage, session_id="test-session",
+                             report_memory=False)
+    net.set_listeners(listener)
+    rng = np.random.default_rng(0)
+    x = rng.random((8 * n_iters, 784), np.float32)
+    y = np.zeros((8 * n_iters, 10), np.float32)
+    y[np.arange(8 * n_iters), rng.integers(0, 10, 8 * n_iters)] = 1.0
+    net.fit(x, y, batch_size=8, epochs=1, async_prefetch=False)
+    return net
+
+
+def test_codec_round_trip():
+    rec = {
+        "iteration": 42, "ts": 123.5, "score": 0.75, "etl_ms": 1.5,
+        "samples_per_sec": 1000.0, "epoch": 3,
+        "grad_mm": {"0_W": 0.5, "0_b": 0.25},
+        "hist": [1.0, 2.0, 3.0],
+    }
+    out = decode_record(encode_record(rec))
+    assert out["iteration"] == 42
+    assert abs(out["score"] - 0.75) < 1e-6
+    assert abs(out["grad_mm"]["0_W"] - 0.5) < 1e-6
+    assert out["hist"] == [1.0, 2.0, 3.0]
+    assert out["epoch"] == 3.0
+
+
+def test_stats_listener_collects_fused_stats():
+    storage = InMemoryStatsStorage()
+    _train_with_listener(storage)
+    assert storage.list_session_ids() == ["test-session"]
+    static = storage.get_static_info("test-session")
+    assert static["total_params"] > 0
+    assert static["model_class"] == "MultiLayerNetwork"
+    ups = storage.get_updates("test-session")
+    assert len(ups) == 6
+    u = ups[-1]
+    assert np.isfinite(u["score"])
+    # fused grad/update/param mean magnitudes present and positive
+    for group in ("grad_mm", "update_mm", "param_mm"):
+        assert u[group], group
+        assert all(v >= 0 for v in u[group].values())
+    # incremental read
+    later = storage.get_updates("test-session",
+                                since_iteration=ups[2]["iteration"])
+    assert len(later) == 3
+
+
+def test_file_stats_storage_cold_read(tmp_path):
+    path = str(tmp_path / "stats.bin")
+    storage = FileStatsStorage(path)
+    _train_with_listener(storage, n_iters=3)
+    # reopen cold, as the dashboard would for a finished run
+    cold = FileStatsStorage(path)
+    assert cold.list_session_ids() == ["test-session"]
+    assert len(cold.get_updates("test-session")) == 3
+    assert cold.get_static_info("test-session")["total_params"] > 0
+
+
+def _get(port, route):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{route}") as r:
+        ct = r.headers.get("Content-Type", "")
+        body = r.read()
+    return ct, body
+
+
+def test_ui_server_routes():
+    storage = InMemoryStatsStorage()
+    _train_with_listener(storage, n_iters=4)
+    server = UIServer(storage, port=0)
+    port = server.start()
+    try:
+        ct, body = _get(port, "/train/overview")
+        assert "text/html" in ct and b"dl4j-tpu" in body
+        _, body = _get(port, "/train/overview/data")
+        d = json.loads(body)
+        assert len(d["score"]) == 4
+        assert d["session"] == "test-session"
+        _, body = _get(port, "/train/model/data")
+        d = json.loads(body)
+        assert d["layers"], "model view should list layers"
+        assert any(l["series"] for l in d["layers"])
+        _, body = _get(port, "/train/system/data")
+        d = json.loads(body)
+        assert d["static"]["model_class"] == "MultiLayerNetwork"
+        _, body = _get(port, "/train/sessions/all")
+        assert json.loads(body)["sessions"] == ["test-session"]
+    finally:
+        server.stop()
+
+
+def test_remote_router_to_ui_server():
+    """Remote training process -> POST /remote -> dashboard storage
+    (reference: RemoteReceiverModule + remote listeners)."""
+    storage = InMemoryStatsStorage()
+    server = UIServer(storage, port=0)
+    port = server.start()
+    try:
+        router = RemoteUIStatsStorageRouter(f"http://127.0.0.1:{port}")
+        _train_with_listener(router, n_iters=3)
+        router.flush()
+        # records crossed the HTTP boundary into the server's storage
+        ups = storage.get_updates("test-session")
+        assert len(ups) == 3
+        assert np.isfinite(ups[-1]["score"])
+        assert ups[-1]["grad_mm"]
+    finally:
+        server.stop()
