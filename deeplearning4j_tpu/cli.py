@@ -1,0 +1,208 @@
+"""Operator CLI (reference: ParallelWrapperMain.java:28-54 — train a
+serialized model from flags; NearestNeighborsServer; PlayUIServer runnable).
+
+    python -m deeplearning4j_tpu.cli train --model-path m.zip --data iris \
+        --epochs 3 --batch-size 32 --output trained.zip --ui-port 9090
+    python -m deeplearning4j_tpu.cli evaluate --model-path m.zip --data iris
+    python -m deeplearning4j_tpu.cli knn-server --ndarray-path pts.npy
+    python -m deeplearning4j_tpu.cli ui-server --stats-file stats.bin
+
+Data sources: mnist | cifar10 | iris | csv:<path>:<labelIndex>:<numClasses>
+Model zips: this framework's format (utils/model_serializer), a DL4J
+reference zip (modelimport/dl4j), or a Keras 1.x .h5 — sniffed by
+ModelGuesser the way util/ModelGuesser.java does."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import zipfile
+
+
+def guess_and_load_model(path: str):
+    """ModelGuesser analog (reference: core util/ModelGuesser.java): sniff
+    the container format and dispatch to the right loader."""
+    if path.endswith((".h5", ".hdf5")):
+        from deeplearning4j_tpu.modelimport.keras import (
+            import_keras_model_and_weights,
+        )
+
+        return import_keras_model_and_weights(path)
+    with zipfile.ZipFile(path) as zf:
+        names = set(zf.namelist())
+    # both formats carry configuration.json + coefficients.bin; only this
+    # framework's zips have meta.json (utils/model_serializer)
+    if "coefficients.bin" in names and "meta.json" not in names:
+        from deeplearning4j_tpu.modelimport.dl4j import (
+            import_dl4j_multilayer,
+        )
+
+        return import_dl4j_multilayer(path)
+    from deeplearning4j_tpu.utils.model_serializer import load_model
+
+    return load_model(path)
+
+
+def _data_iterator(spec: str, batch_size: int, train: bool = True):
+    if spec == "mnist":
+        from deeplearning4j_tpu.data.mnist import (
+            MnistDataFetcher,
+            MnistDataSetIterator,
+        )
+
+        return MnistDataSetIterator(
+            batch_size, train=train,
+            fetcher=MnistDataFetcher(allow_download=True))
+    if spec == "cifar10":
+        from deeplearning4j_tpu.data.fetchers import CifarDataSetIterator
+
+        return CifarDataSetIterator(batch_size, train=train)
+    if spec == "iris":
+        from deeplearning4j_tpu.data.fetchers import IrisDataSetIterator
+
+        return IrisDataSetIterator(batch_size)
+    if spec.startswith("csv:"):
+        _, path, label_idx, n_classes = spec.split(":")
+        from deeplearning4j_tpu.data.records import (
+            CSVRecordReader,
+            RecordReaderDataSetIterator,
+        )
+
+        return RecordReaderDataSetIterator(
+            CSVRecordReader(path), batch_size,
+            label_index=int(label_idx), num_classes=int(n_classes))
+    raise SystemExit(f"unknown --data {spec!r} "
+                     "(mnist|cifar10|iris|csv:<path>:<label>:<classes>)")
+
+
+def cmd_train(args) -> int:
+    net = guess_and_load_model(args.model_path)
+    it = _data_iterator(args.data, args.batch_size)
+
+    listeners = []
+    from deeplearning4j_tpu.train.listeners import ScoreIterationListener
+
+    listeners.append(ScoreIterationListener(args.print_every,
+                                            print_fn=print))
+    ui_server = None
+    if args.ui_port is not None:
+        from deeplearning4j_tpu.ui import (
+            InMemoryStatsStorage,
+            StatsListener,
+            UIServer,
+        )
+
+        storage = InMemoryStatsStorage()
+        net.set_collect_stats(True)
+        listeners.append(StatsListener(storage))
+        ui_server = UIServer(storage, port=args.ui_port)
+        print(f"training UI on http://127.0.0.1:{ui_server.start()}/train")
+    net.set_listeners(*listeners)
+
+    if args.workers > 1 or args.data_parallel:
+        from deeplearning4j_tpu.parallel import (
+            ParallelWrapper,
+            data_parallel_mesh,
+        )
+
+        ParallelWrapper(net, data_parallel_mesh()).fit(
+            it, epochs=args.epochs)
+    else:
+        net.fit(it, epochs=args.epochs)
+
+    if args.output:
+        from deeplearning4j_tpu.utils.model_serializer import save_model
+
+        save_model(net, args.output)
+        print(f"saved trained model to {args.output}")
+    if ui_server is not None and args.ui_hold:
+        print("training done; UI still serving (ctrl-C to exit)")
+        try:
+            import time
+
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            pass
+    return 0
+
+
+def cmd_evaluate(args) -> int:
+    net = guess_and_load_model(args.model_path)
+    it = _data_iterator(args.data, args.batch_size, train=False)
+    ev = net.evaluate(it)
+    print(ev.stats())
+    return 0
+
+
+def cmd_knn_server(args) -> int:
+    from deeplearning4j_tpu.serving.knnserver import main as knn_main
+
+    knn_main([
+        "--ndarrayPath", args.ndarray_path,
+        "--nearestNeighborsPort", str(args.port),
+        "--similarityFunction", args.similarity_function,
+    ] + (["--invert"] if args.invert else []))
+    return 0
+
+
+def cmd_ui_server(args) -> int:
+    from deeplearning4j_tpu.ui import FileStatsStorage, UIServer
+
+    storage = FileStatsStorage(args.stats_file)
+    server = UIServer(storage, port=args.port)
+    port = server.start()
+    print(f"ui server on http://127.0.0.1:{port}/train "
+          f"({len(storage.list_session_ids())} sessions)")
+    try:
+        import time
+
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        server.stop()
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="deeplearning4j_tpu")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    t = sub.add_parser("train", help="train a serialized model from flags")
+    t.add_argument("--model-path", required=True)
+    t.add_argument("--data", required=True)
+    t.add_argument("--epochs", type=int, default=1)
+    t.add_argument("--batch-size", type=int, default=32)
+    t.add_argument("--workers", type=int, default=1)
+    t.add_argument("--data-parallel", action="store_true",
+                   help="shard batches over all visible devices")
+    t.add_argument("--output", default=None)
+    t.add_argument("--print-every", type=int, default=10)
+    t.add_argument("--ui-port", type=int, default=None)
+    t.add_argument("--ui-hold", action="store_true")
+    t.set_defaults(fn=cmd_train)
+
+    e = sub.add_parser("evaluate", help="evaluate a serialized model")
+    e.add_argument("--model-path", required=True)
+    e.add_argument("--data", required=True)
+    e.add_argument("--batch-size", type=int, default=128)
+    e.set_defaults(fn=cmd_evaluate)
+
+    k = sub.add_parser("knn-server", help="REST k-NN server over a VPTree")
+    k.add_argument("--ndarray-path", required=True)
+    k.add_argument("--port", type=int, default=9000)
+    k.add_argument("--similarity-function", default="euclidean")
+    k.add_argument("--invert", action="store_true")
+    k.set_defaults(fn=cmd_knn_server)
+
+    u = sub.add_parser("ui-server", help="dashboard over a stats file")
+    u.add_argument("--stats-file", required=True)
+    u.add_argument("--port", type=int, default=9090)
+    u.set_defaults(fn=cmd_ui_server)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

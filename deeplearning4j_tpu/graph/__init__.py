@@ -1,0 +1,9 @@
+"""Graph embeddings (reference: deeplearning4j-graph, 3,363 LoC —
+IGraph/Graph, random-walk iterators, DeepWalk + GraphHuffman +
+InMemoryGraphLookupTable, GraphVectors serving API)."""
+
+from deeplearning4j_tpu.graph.graph import Graph
+from deeplearning4j_tpu.graph.deepwalk import DeepWalk, GraphVectors
+from deeplearning4j_tpu.graph.walkers import RandomWalkIterator
+
+__all__ = ["Graph", "DeepWalk", "GraphVectors", "RandomWalkIterator"]

@@ -1,0 +1,51 @@
+"""Random-walk sequence generators (reference: graph/iterator/
+RandomWalkIterator.java + WeightedWalkIterator — fixed-length walks
+starting from every vertex, with a NoEdgeHandling policy for dead ends)."""
+
+from __future__ import annotations
+
+from typing import Iterator, List
+
+import numpy as np
+
+from deeplearning4j_tpu.graph.graph import Graph
+
+
+class NoEdgeHandling:
+    SELF_LOOP = "self_loop"          # stay at the vertex
+    EXCEPTION = "exception"
+    CUTOFF = "cutoff"                # end the walk early
+
+
+class RandomWalkIterator:
+    """Yields one fixed-length walk per start vertex per epoch, in
+    shuffled vertex order (reference semantics)."""
+
+    def __init__(self, graph: Graph, walk_length: int,
+                 weighted: bool = False, seed: int = 0,
+                 no_edge_handling: str = NoEdgeHandling.SELF_LOOP):
+        self.graph = graph
+        self.walk_length = int(walk_length)
+        self.weighted = weighted
+        self.no_edge = no_edge_handling
+        self._rng = np.random.default_rng(seed)
+
+    def walk_from(self, start: int) -> List[int]:
+        walk = [start]
+        v = start
+        for _ in range(self.walk_length):
+            nxt = self.graph.random_neighbor(v, self._rng, self.weighted)
+            if nxt is None:
+                if self.no_edge == NoEdgeHandling.EXCEPTION:
+                    raise RuntimeError(f"vertex {v} has no outgoing edges")
+                if self.no_edge == NoEdgeHandling.CUTOFF:
+                    break
+                nxt = v  # self loop
+            walk.append(nxt)
+            v = nxt
+        return walk
+
+    def __iter__(self) -> Iterator[List[int]]:
+        order = self._rng.permutation(self.graph.num_vertices)
+        for start in order:
+            yield self.walk_from(int(start))

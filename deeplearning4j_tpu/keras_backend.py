@@ -1,0 +1,134 @@
+"""Keras-backend entry point (reference: deeplearning4j-keras, 452 LoC —
+a py4j GatewayServer exposing DeepLearning4jEntryPoint.fit(), with batch
+data handed over as HDF5 files; DeepLearning4jEntryPoint.java:22-41).
+
+TPU-native shape: the frontend language IS Python here, so the gateway
+degenerates to (a) a direct function — fit_from_keras_config — and (b) an
+HTTP entry point for out-of-process frontends, accepting the same payload
+the reference took over py4j: a Keras 1.x model-config JSON plus feature/
+label arrays (npy paths or HDF5 datasets)."""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.modelimport.keras import (
+    import_keras_sequential_config,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+def _load_array(path: str, dataset: Optional[str] = None) -> np.ndarray:
+    if path.endswith((".h5", ".hdf5")):
+        import h5py
+
+        with h5py.File(path, "r") as f:
+            return np.asarray(f[dataset or "data"])
+    return np.load(path)
+
+
+def fit_from_keras_config(model_config_json: str,
+                          features: np.ndarray, labels: np.ndarray,
+                          *, training_config_json: Optional[str] = None,
+                          batch_size: int = 32, nb_epoch: int = 1,
+                          precision: str = "f32"):
+    """The EntryPoint.fit analog: build the network from a Keras 1.x
+    Sequential config, train, return (net, final_score). Without a
+    training_config the loss defaults to categorical crossentropy (the
+    reference's entry point always receives a compiled model; a bare
+    architecture still has to train here)."""
+    if training_config_json is None:
+        training_config_json = json.dumps(
+            {"loss": "categorical_crossentropy"})
+    conf, _ = import_keras_sequential_config(
+        model_config_json, training_config_json, precision=precision)
+    net = MultiLayerNetwork(conf).init()
+    net.fit(np.asarray(features), np.asarray(labels),
+            batch_size=batch_size, epochs=nb_epoch)
+    return net, float(np.asarray(net._score))
+
+
+class KerasBackendServer:
+    """POST /fit
+    {"model_config": "<keras json>", "features_path": ..., "labels_path":
+     ..., "batch_size": 32, "nb_epoch": 1} -> {"score": float}
+    The model is retained; POST /evaluate {"features_path", "labels_path"}
+    scores it."""
+
+    def __init__(self, port: int = 0):
+        self.port = int(port)
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._net: Optional[MultiLayerNetwork] = None
+        self._lock = threading.Lock()
+
+    def _fit(self, body: dict) -> dict:
+        x = _load_array(body["features_path"], body.get("features_dataset"))
+        y = _load_array(body["labels_path"], body.get("labels_dataset"))
+        with self._lock:
+            net, score = fit_from_keras_config(
+                body["model_config"], x, y,
+                training_config_json=body.get("training_config"),
+                batch_size=int(body.get("batch_size", 32)),
+                nb_epoch=int(body.get("nb_epoch", 1)))
+            self._net = net
+        return {"score": score}
+
+    def _evaluate(self, body: dict) -> dict:
+        if self._net is None:
+            raise ValueError("no model fitted yet")
+        x = _load_array(body["features_path"], body.get("features_dataset"))
+        y = _load_array(body["labels_path"], body.get("labels_dataset"))
+        with self._lock:
+            ev = self._net.evaluate(
+                self._make_iter(x, y, int(body.get("batch_size", 128))))
+        return {"accuracy": ev.accuracy(), "f1": ev.f1()}
+
+    @staticmethod
+    def _make_iter(x, y, batch):
+        from deeplearning4j_tpu.data.dataset import DataSet
+        from deeplearning4j_tpu.data.iterators import ListDataSetIterator
+
+        return ListDataSetIterator(DataSet(x, y), batch)
+
+    def start(self) -> int:
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                try:
+                    body = json.loads(self.rfile.read(n))
+                    if self.path == "/fit":
+                        payload, code = outer._fit(body), 200
+                    elif self.path == "/evaluate":
+                        payload, code = outer._evaluate(body), 200
+                    else:
+                        payload, code = {"error": "no route"}, 404
+                except Exception as e:  # surface as JSON, keep serving
+                    payload, code = {"error": f"{type(e).__name__}: {e}"}, 400
+                data = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port), Handler)
+        self.port = self._httpd.server_address[1]
+        threading.Thread(target=self._httpd.serve_forever,
+                         daemon=True).start()
+        return self.port
+
+    def stop(self):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None

@@ -1,0 +1,382 @@
+"""DL4J model-zip importer — the migration path from the reference.
+
+Reference format (util/ModelSerializer.java:40,79-118): a zip holding
+  configuration.json  — MultiLayerConfiguration Jackson JSON (layer confs
+                        wrapped by type name, Layer.java:47-48 WRAPPER_OBJECT)
+  coefficients.bin    — Nd4j.write of the single flattened f32/f64 params
+                        row vector (MultiLayerNetwork.java:102 flattenedParams)
+  updaterState.bin    — optional flattened updater state (not imported —
+                        optimizer moments restart; scores/outputs don't)
+
+Flat layouts mirrored from nn/params/* (the load-bearing part):
+  Dense/Output/RnnOutput/Embedding (DefaultParamInitializer): W [nIn,nOut]
+    f-order, then b [nOut].
+  Convolution (ConvolutionParamInitializer:140): W [nOut,nIn,kh,kw]
+    f-order, then b [nOut] -> transposed to this framework's HWIO.
+  BatchNormalization (BatchNormalizationParamInitializer:56-70): gamma,
+    beta, then the running mean/var — params in DL4J, STATE here.
+  LSTM (LSTMParamInitializer:init): W [nIn,4H], RW [H,4H], b [4H], gate
+    blocks ordered [I,F,O,G] where I is the tanh candidate and G the
+    sigmoid input gate (LSTMHelpers.java:64,213-215); this framework
+    orders blocks [input gate, forget, candidate, output], so columns
+    permute [G,F,I,O] -> [i,f,g,o] on import.
+  GravesLSTM (GravesLSTMParamInitializer): RW [H,4H+3] with peephole
+    columns [wFF,wOO,wGG] appended (LSTMHelpers.java:104-115); wGG feeds
+    the sigmoid input gate -> pI, wFF -> pF, wOO -> pO.
+
+Binary array format: the era's Nd4j.write(arr, DataOutputStream) —
+big-endian: shape-info buffer (int count, then rank/shape/stride/offset/
+elementWiseStride/order ints) followed by a UTF-8 dtype tag and the raw
+elements. write_nd4j_array produces the same layout (fixture generation +
+export interop).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import struct
+import zipfile
+from typing import List, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+
+
+# -- Nd4j legacy binary array format ----------------------------------------
+
+_DTYPES = {"FLOAT": ("f", 4), "DOUBLE": ("d", 8)}
+
+
+def write_nd4j_array(arr: np.ndarray, stream) -> None:
+    """Serialize in the legacy Nd4j.write layout (big-endian, Java
+    DataOutputStream conventions). Arrays are written as 2-d row vectors
+    in 'c' order with contiguous strides, matching flattened params."""
+    arr = np.asarray(arr)
+    if arr.ndim == 1:
+        arr = arr[None, :]
+    rank = arr.ndim
+    shape = list(arr.shape)
+    # c-order strides in elements
+    strides = []
+    acc = 1
+    for d in reversed(shape):
+        strides.insert(0, acc)
+        acc *= d
+    info = [rank] + shape + strides + [0, 1, ord("c")]
+    stream.write(struct.pack(">i", len(info)))
+    stream.write(struct.pack(f">{len(info)}i", *info))
+    if arr.dtype == np.float64:
+        tag, fmt = "DOUBLE", "d"
+    else:
+        arr = arr.astype(np.float32)
+        tag, fmt = "FLOAT", "f"
+    tag_b = tag.encode()
+    stream.write(struct.pack(">H", len(tag_b)) + tag_b)  # writeUTF
+    flat = arr.reshape(-1)
+    stream.write(struct.pack(f">{flat.size}{fmt}", *flat.tolist()))
+
+
+def read_nd4j_array(stream) -> np.ndarray:
+    """Parse the legacy Nd4j.write layout back into numpy (row vector)."""
+    (n_info,) = struct.unpack(">i", stream.read(4))
+    info = struct.unpack(f">{n_info}i", stream.read(4 * n_info))
+    rank = info[0]
+    shape = list(info[1 : 1 + rank])
+    order = chr(info[-1])
+    (tag_len,) = struct.unpack(">H", stream.read(2))
+    tag = stream.read(tag_len).decode()
+    if tag not in _DTYPES:
+        raise ValueError(f"unsupported nd4j dtype tag {tag!r}")
+    fmt, width = _DTYPES[tag]
+    count = int(np.prod(shape)) if shape else 0
+    data = struct.unpack(f">{count}{fmt}", stream.read(width * count))
+    a = np.array(data, np.float32 if tag == "FLOAT" else np.float64)
+    return a.reshape(shape, order="f" if order == "f" else "c")
+
+
+# -- configuration.json -> config DSL ----------------------------------------
+
+def _act(name):
+    return (name or "identity").lower()
+
+
+def _loss_name(layer_json):
+    ln = layer_json.get("lossFn") or layer_json.get("lossFunction")
+    if isinstance(ln, dict):  # ILossFunction object form {"@class": ...}
+        cls = ln.get("@class", "")
+        mapping = {
+            "LossMCXENT": "mcxent", "LossMSE": "mse",
+            "LossBinaryXENT": "xent", "LossNegativeLogLikelihood":
+            "negativeloglikelihood", "LossL2": "l2", "LossL1": "l1",
+            "LossKLD": "kl_divergence", "LossCosineProximity":
+            "cosine_proximity", "LossHinge": "hinge",
+            "LossSquaredHinge": "squared_hinge", "LossPoisson": "poisson",
+            "LossMAE": "mean_absolute_error",
+        }
+        for key, val in mapping.items():
+            if key in cls:
+                return val
+        raise ValueError(f"unmapped DL4J loss class {cls!r}")
+    return (ln or "mcxent").lower()
+
+
+def _map_layer(name: str, lj: dict):
+    """One DL4J layer-conf JSON object -> (this framework's config, DL4J
+    type tag). Covers the importable parameterized layer set."""
+    act = _act(lj.get("activationFn") or lj.get("activation"))
+    n_in = int(lj.get("nin") or lj.get("nIn") or 0)
+    n_out = int(lj.get("nout") or lj.get("nOut") or 0)
+    common = dict(n_in=n_in or None, n_out=n_out or None, activation=act)
+    if name == "dense":
+        return L.DenseLayer(**common)
+    if name == "output":
+        return L.OutputLayer(loss=_loss_name(lj), **common)
+    if name == "rnnoutput":
+        return L.RnnOutputLayer(loss=_loss_name(lj), **common)
+    if name == "convolution":
+        return L.ConvolutionLayer(
+            kernel_size=tuple(lj.get("kernelSize", (3, 3))),
+            stride=tuple(lj.get("stride", (1, 1))),
+            padding=tuple(lj.get("padding", (0, 0))),
+            convolution_mode="same"
+            if lj.get("convolutionMode") == "Same" else "truncate",
+            **common,
+        )
+    if name == "subsampling":
+        pt = (lj.get("poolingType") or "MAX").lower()
+        return L.SubsamplingLayer(
+            pooling_type=pt,
+            kernel_size=tuple(lj.get("kernelSize", (2, 2))),
+            stride=tuple(lj.get("stride", (2, 2))),
+            padding=tuple(lj.get("padding", (0, 0))),
+            convolution_mode="same"
+            if lj.get("convolutionMode") == "Same" else "truncate",
+        )
+    if name == "batchNormalization":
+        return L.BatchNormalization(
+            n_in=n_in or None, eps=lj.get("eps", 1e-5),
+            decay=lj.get("decay", 0.9),
+        )
+    if name in ("LSTM", "gravesLSTM"):
+        cls = L.LSTM if name == "LSTM" else L.GravesLSTM
+        return cls(
+            forget_gate_bias_init=lj.get("forgetGateBiasInit", 1.0),
+            gate_activation=_act(lj.get("gateActivationFn", "sigmoid")),
+            **common,
+        )
+    if name == "embedding":
+        return L.EmbeddingLayer(**common)
+    if name == "activation":
+        return L.ActivationLayer(activation=act)
+    if name == "dropout":
+        return L.DropoutLayer(dropout=lj.get("dropOut", 0.5))
+    if name == "globalPooling":
+        return L.GlobalPoolingLayer(
+            pooling_type=(lj.get("poolingType") or "MAX").lower())
+    raise ValueError(f"unsupported DL4J layer type {name!r} for import")
+
+
+def _perm_ifog(cols: np.ndarray, H: int) -> np.ndarray:
+    """Columns [I,F,O,G] (DL4J: I=candidate, G=input gate,
+    LSTMHelpers.java:64) -> this framework's [i(gate), f, g(candidate),
+    o]: take DL4J blocks [G, F, I, O]."""
+    I, F, O, G = (cols[..., i * H:(i + 1) * H] for i in range(4))
+    return np.concatenate([G, F, I, O], axis=-1)
+
+
+# -- the importer ------------------------------------------------------------
+
+def import_dl4j_multilayer(path: str, precision: str = "f32"):
+    """Load a reference-format model zip into a MultiLayerNetwork.
+
+    Returns the network with parameters (and BN running stats) restored;
+    updater state is not imported (documented above)."""
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    with zipfile.ZipFile(path) as zf:
+        conf_json = json.loads(zf.read("configuration.json"))
+        flat = read_nd4j_array(io.BytesIO(zf.read("coefficients.bin")))
+    flat = np.asarray(flat).reshape(-1)
+
+    confs = conf_json.get("confs", [])
+    layers: List = []
+    tags: List[str] = []
+    for c in confs:
+        lj = c.get("layer", {})
+        if not lj:
+            raise ValueError("conf without layer entry")
+        (tag, body), = lj.items()
+        layers.append(_map_layer(tag, body))
+        tags.append(tag)
+
+    builder = NeuralNetConfiguration.builder().precision(precision).list()
+    for l in layers:
+        builder = builder.layer(l)
+    # input type from the first layer's nIn (feed-forward/recurrent import;
+    # CNN zips additionally carry inputPreProcessors, mapped coarsely here)
+    first = layers[0]
+    if isinstance(first, (L.LSTM, L.GravesLSTM)):
+        builder = builder.set_input_type(InputType.recurrent(first.n_in))
+    else:
+        builder = builder.set_input_type(InputType.feed_forward(first.n_in))
+    net = MultiLayerNetwork(builder.build()).init()
+
+    # walk the flat buffer in layer order, mirroring nn/params layouts
+    off = 0
+
+    def take(n):
+        nonlocal off
+        out = flat[off:off + n]
+        if out.size != n:
+            raise ValueError(
+                f"coefficients.bin too short: wanted {n} at offset {off}, "
+                f"have {flat.size}")
+        off += n
+        return out
+
+    for i, (tag, lc) in enumerate(zip(tags, layers)):
+        p = net.params_list[i]
+        if tag in ("dense", "output", "rnnoutput", "embedding"):
+            n_in, n_out = int(lc.n_in), int(lc.n_out)
+            W = take(n_in * n_out).reshape((n_in, n_out), order="F")
+            b = take(n_out)
+            p["W"] = p["W"].at[:].set(W)
+            p["b"] = p["b"].at[:].set(b)
+        elif tag == "convolution":
+            kh, kw = (int(k) for k in lc.kernel_size)
+            n_in, n_out = int(lc.n_in), int(lc.n_out)
+            W = take(n_out * n_in * kh * kw).reshape(
+                (n_out, n_in, kh, kw), order="F")
+            p["W"] = p["W"].at[:].set(W.transpose(2, 3, 1, 0))  # -> HWIO
+            p["b"] = p["b"].at[:].set(take(n_out))
+        elif tag == "batchNormalization":
+            n = int(lc.n_in)
+            p["gamma"] = p["gamma"].at[:].set(take(n))
+            p["beta"] = p["beta"].at[:].set(take(n))
+            mean, var = take(n), take(n)
+            st = dict(net.state_list[i] or {})
+            st["mean"] = st["mean"].at[:].set(mean)
+            st["var"] = st["var"].at[:].set(var)
+            net.state_list[i] = st
+        elif tag in ("LSTM", "gravesLSTM"):
+            n_in, H = int(lc.n_in), int(lc.n_out)
+            W = take(n_in * 4 * H).reshape((n_in, 4 * H), order="F")
+            rw_cols = 4 * H + (3 if tag == "gravesLSTM" else 0)
+            RW_full = take(H * rw_cols).reshape((H, rw_cols), order="F")
+            b = take(4 * H)
+            p["W"] = p["W"].at[:].set(_perm_ifog(W, H))
+            p["RW"] = p["RW"].at[:].set(_perm_ifog(RW_full[:, :4 * H], H))
+            p["b"] = p["b"].at[:].set(_perm_ifog(b[None, :], H)[0])
+            if tag == "gravesLSTM":
+                # peephole columns [wFF, wOO, wGG] (LSTMHelpers.java:104)
+                p["pF"] = p["pF"].at[:].set(RW_full[:, 4 * H])
+                p["pO"] = p["pO"].at[:].set(RW_full[:, 4 * H + 1])
+                p["pI"] = p["pI"].at[:].set(RW_full[:, 4 * H + 2])
+        elif tag in ("activation", "dropout", "subsampling", "globalPooling"):
+            pass  # no params
+        else:
+            raise ValueError(f"no flat layout for layer tag {tag!r}")
+    if off != flat.size:
+        raise ValueError(
+            f"coefficients.bin length mismatch: consumed {off} of {flat.size}")
+    return net
+
+
+# -- fixture/export writer ---------------------------------------------------
+
+def export_dl4j_zip(net, path: str) -> None:
+    """Write a network in the reference zip format (the inverse mapping of
+    import_dl4j_multilayer — used for fixtures and for handing models back
+    to reference-era tooling). Only layer types listed above."""
+    conf_out = {"confs": []}
+    flat_parts: List[np.ndarray] = []
+    for i, lc in enumerate(net.layer_confs):
+        p = {k: np.asarray(v) for k, v in net.params_list[i].items()}
+        if isinstance(lc, L.ConvolutionLayer):
+            tag = "convolution"
+            body = {
+                "nin": int(lc.n_in), "nout": int(lc.n_out),
+                "activationFn": lc.activation,
+                "kernelSize": list(lc.kernel_size),
+                "stride": list(lc.stride), "padding": list(lc.padding),
+                "convolutionMode":
+                    "Same" if str(lc.convolution_mode).endswith("same")
+                    else "Truncate",
+            }
+            W = p["W"].transpose(3, 2, 0, 1)  # HWIO -> [nOut,nIn,kh,kw]
+            flat_parts += [W.reshape(-1, order="F"), p["b"].reshape(-1)]
+        elif isinstance(lc, L.BatchNormalization):
+            tag = "batchNormalization"
+            body = {"nin": int(lc.n_in), "nout": int(lc.n_in),
+                    "eps": lc.eps, "decay": lc.decay}
+            st = net.state_list[i] or {}
+            flat_parts += [p["gamma"], p["beta"],
+                           np.asarray(st.get("mean")),
+                           np.asarray(st.get("var"))]
+        elif isinstance(lc, (L.LSTM, L.GravesLSTM)):
+            graves = isinstance(lc, L.GravesLSTM)
+            tag = "gravesLSTM" if graves else "LSTM"
+            H = int(lc.n_out)
+            body = {"nin": int(lc.n_in), "nout": H,
+                    "activationFn": lc.activation,
+                    "gateActivationFn": lc.gate_activation,
+                    "forgetGateBiasInit": lc.forget_gate_bias_init}
+            inv = lambda cols: np.concatenate(
+                [cols[..., 2 * H:3 * H],           # I <- my g (candidate)
+                 cols[..., H:2 * H],               # F <- my f
+                 cols[..., 3 * H:],                # O <- my o
+                 cols[..., :H]], axis=-1)          # G <- my i (input gate)
+            RW = inv(p["RW"])
+            if graves:
+                RW = np.concatenate(
+                    [RW, p["pF"][:, None], p["pO"][:, None],
+                     p["pI"][:, None]], axis=1)
+            flat_parts += [inv(p["W"]).reshape(-1, order="F"),
+                           RW.reshape(-1, order="F"),
+                           inv(p["b"][None, :])[0]]
+        elif isinstance(lc, L.OutputLayer):
+            tag = "output"
+            body = {"nin": int(lc.n_in), "nout": int(lc.n_out),
+                    "activationFn": lc.activation, "lossFn": lc.loss}
+            flat_parts += [p["W"].reshape(-1, order="F"), p["b"].reshape(-1)]
+        elif isinstance(lc, L.RnnOutputLayer):
+            tag = "rnnoutput"
+            body = {"nin": int(lc.n_in), "nout": int(lc.n_out),
+                    "activationFn": lc.activation, "lossFn": lc.loss}
+            flat_parts += [p["W"].reshape(-1, order="F"), p["b"].reshape(-1)]
+        elif isinstance(lc, L.DenseLayer):
+            tag = "dense"
+            body = {"nin": int(lc.n_in), "nout": int(lc.n_out),
+                    "activationFn": lc.activation}
+            flat_parts += [p["W"].reshape(-1, order="F"), p["b"].reshape(-1)]
+        elif isinstance(lc, L.EmbeddingLayer):
+            tag = "embedding"
+            body = {"nin": int(lc.n_in), "nout": int(lc.n_out),
+                    "activationFn": lc.activation}
+            flat_parts += [p["W"].reshape(-1, order="F"), p["b"].reshape(-1)]
+        elif isinstance(lc, L.ActivationLayer):
+            tag, body = "activation", {"activationFn": lc.activation}
+        elif isinstance(lc, L.SubsamplingLayer):
+            tag = "subsampling"
+            body = {"poolingType": str(lc.pooling_type).upper(),
+                    "kernelSize": list(lc.kernel_size),
+                    "stride": list(lc.stride), "padding": list(lc.padding),
+                    "convolutionMode":
+                        "Same" if str(lc.convolution_mode).endswith("same")
+                        else "Truncate"}
+        else:
+            raise ValueError(f"cannot export layer {type(lc).__name__}")
+        conf_out["confs"].append({"layer": {tag: body}})
+
+    flat = (np.concatenate([f.astype(np.float32).reshape(-1)
+                            for f in flat_parts])
+            if flat_parts else np.zeros(0, np.float32))
+    buf = io.BytesIO()
+    write_nd4j_array(flat, buf)
+    with zipfile.ZipFile(path, "w") as zf:
+        zf.writestr("configuration.json", json.dumps(conf_out))
+        zf.writestr("coefficients.bin", buf.getvalue())

@@ -49,7 +49,7 @@ from deeplearning4j_tpu.nn.multilayer import (
     _regularizable,
 )
 from deeplearning4j_tpu.nn.netbase import NetworkBase
-from deeplearning4j_tpu.ops.losses import loss_value
+from deeplearning4j_tpu.ops.losses import example_presence, masked_example_mean, loss_value
 from deeplearning4j_tpu.train.evaluation import Evaluation
 from deeplearning4j_tpu.train.updaters import (
     normalize_gradients,
@@ -203,7 +203,7 @@ class ComputationGraph(NetworkBase):
                 lc.loss, ys[i], self.policy.cast_output(acts[name]),
                 lc.activation, lm,
             )
-            score = score + jnp.mean(per_ex)
+            score = score + masked_example_mean(per_ex, lm)
             if isinstance(lc, L.CenterLossOutputLayer):
                 # center loss head (reference: CenterLossOutputLayer.java):
                 # + lambda * mean(0.5||f - c_y||^2) on the head's input
@@ -214,11 +214,15 @@ class ComputationGraph(NetworkBase):
                 y32 = ys[i].astype(feats.dtype)
                 diff = feats - y32 @ centers
                 center_per_ex = 0.5 * jnp.sum(diff * diff, axis=-1)
-                score = score + lc.lambda_ * jnp.mean(center_per_ex)
+                present = example_presence(per_ex, lm)
+                score = score + lc.lambda_ * (
+                    jnp.sum(center_per_ex * present)
+                    / jnp.maximum(jnp.sum(present), 1.0))
                 if training:
                     f_sg = jax.lax.stop_gradient(feats)
-                    counts = jnp.sum(y32, axis=0)[:, None]
-                    means = (y32.T @ f_sg) / jnp.maximum(counts, 1.0)
+                    yw = y32 * present[:, None]
+                    counts = jnp.sum(yw, axis=0)[:, None]
+                    means = (yw.T @ f_sg) / jnp.maximum(counts, 1.0)
                     updated = jnp.where(
                         counts > 0,
                         (1.0 - lc.alpha) * centers + lc.alpha * means,
@@ -376,7 +380,7 @@ class ComputationGraph(NetworkBase):
             mds.features, mds.labels, mds.features_masks, mds.labels_masks
         )
         self.state_list = states
-        self._notify(mds.num_examples())
+        self._notify(getattr(mds, "reported_examples", None) or mds.num_examples())
 
     def _fit_tbptt(self, mds: MultiDataSet):
         """Truncated BPTT over a MultiDataSet: the time axis of every 3-d
@@ -421,7 +425,7 @@ class ComputationGraph(NetworkBase):
                 states, _ = self._fit_step(
                     *cut(slice(start, end)), stateful_states=states
                 )
-            self._notify(mds.num_examples())
+            self._notify(getattr(mds, "reported_examples", None) or mds.num_examples())
         # persist only non-RNN state (running stats); RNN carry is per-batch
         self.state_list = [
             st if not _is_recurrent(lc) else self.state_list[i]

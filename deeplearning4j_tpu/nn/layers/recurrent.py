@@ -78,12 +78,6 @@ def _scan_lstm(conf, params, x, ctx, peephole: bool, prefix: str = "", reverse: 
     xg = jnp.einsum("bti,ih->bth", x, W.astype(x.dtype)) + b.astype(x.dtype)  # all-timestep MXU matmul
     xg_t = jnp.swapaxes(xg, 0, 1)  # time-major for scan
 
-    mask = ctx.mask
-    if mask is not None:
-        mask_t = jnp.swapaxes(mask.astype(x.dtype), 0, 1)[..., None]  # [t,b,1]
-    else:
-        mask_t = None
-
     state = ctx.state or {}
     h0 = state.get("h")
     c0 = state.get("c")
@@ -91,6 +85,25 @@ def _scan_lstm(conf, params, x, ctx, peephole: bool, prefix: str = "", reverse: 
         h0 = jnp.zeros((bsz, H), x.dtype)
     if c0 is None:
         c0 = jnp.zeros((bsz, H), x.dtype)
+
+    # vendor-kernel plugin point (the CudnnHelper analog): a registered
+    # fused-sequence kernel takes over when it supports this configuration
+    from deeplearning4j_tpu.ops.helpers import get_helper
+
+    helper = get_helper(
+        "lstm_sequence", peephole=peephole, mask=ctx.mask,
+        gate_act=conf.gate_activation, cell_act=conf.activation,
+        reverse=reverse,
+    )
+    if helper is not None:
+        ys, hF, cF = helper(xg_t, RW.astype(x.dtype), h0, c0)
+        return jnp.swapaxes(ys, 0, 1), (hF, cF)
+
+    mask = ctx.mask
+    if mask is not None:
+        mask_t = jnp.swapaxes(mask.astype(x.dtype), 0, 1)[..., None]  # [t,b,1]
+    else:
+        mask_t = None
 
     if peephole:
         pI = params[prefix + "pI"].astype(x.dtype)

@@ -46,7 +46,7 @@ from deeplearning4j_tpu.nn.layers.registry import (
     init_layer_state,
 )
 from deeplearning4j_tpu.nn.netbase import NetworkBase
-from deeplearning4j_tpu.ops.losses import loss_value
+from deeplearning4j_tpu.ops.losses import example_presence, masked_example_mean, loss_value
 from deeplearning4j_tpu.train.evaluation import Evaluation, RegressionEvaluation
 from deeplearning4j_tpu.train.updaters import (
     normalize_gradients,
@@ -187,7 +187,7 @@ class MultiLayerNetwork(NetworkBase):
             )
             preout = self.policy.cast_output(preout)
             per_ex = loss_value(last.loss, y, preout, last.activation, l_mask)
-            score = jnp.mean(per_ex)
+            score = masked_example_mean(per_ex, l_mask)
         # L1/L2 penalties (reference: BaseLayer.calcL1/calcL2 added to score;
         # gradients come from differentiating this same expression)
         reg = 0.0
@@ -232,15 +232,19 @@ class MultiLayerNetwork(NetworkBase):
         per_example_center = y32 @ centers  # one-hot pick
         diff = feats - per_example_center
         center_per_ex = 0.5 * jnp.sum(diff * diff, axis=-1)
-        score = jnp.mean(per_ex) + last.lambda_ * jnp.mean(center_per_ex)
+        present = example_presence(per_ex, l_mask)
+        score = (masked_example_mean(per_ex, l_mask)
+                 + last.lambda_ * jnp.sum(center_per_ex * present)
+                 / jnp.maximum(jnp.sum(present), 1.0))
 
         if training:
             # EMA update: c_k <- (1-alpha) c_k + alpha * mean(f_i : y_i = k),
             # only for classes present in the batch; gradients do not flow
             # into the centers (they are state, not params)
             f_sg = jax.lax.stop_gradient(feats)
-            counts = jnp.sum(y32, axis=0)[:, None]  # [classes, 1]
-            sums = y32.T @ f_sg  # [classes, nIn]
+            yw = y32 * present[:, None]  # pad rows excluded from the EMA
+            counts = jnp.sum(yw, axis=0)[:, None]  # [classes, 1]
+            sums = yw.T @ f_sg  # [classes, nIn]
             means = sums / jnp.maximum(counts, 1.0)
             updated = jnp.where(
                 counts > 0, (1.0 - last.alpha) * centers + last.alpha * means,
@@ -534,7 +538,7 @@ class MultiLayerNetwork(NetworkBase):
                 ds.features, ds.labels, ds.features_mask, ds.labels_mask
             )
             self.state_list = states
-            self._notify(ds.num_examples())
+            self._notify(getattr(ds, "reported_examples", None) or ds.num_examples())
 
     def _fit_line_search(self, ds: DataSet, algo: str):
         """Line-search optimizer path (LBFGS/CG/line GD): host-side search
@@ -563,7 +567,7 @@ class MultiLayerNetwork(NetworkBase):
         self.params_list = flat_to_params(self.layer_confs, self.params_list, new_flat)
         self._score = jnp.asarray(f_new)
         self.iteration += 1
-        self._notify(ds.num_examples())
+        self._notify(getattr(ds, "reported_examples", None) or ds.num_examples())
 
     def _fit_tbptt(self, ds: DataSet):
         """Truncated BPTT: split time into segments of tbptt_fwd_length and
@@ -580,9 +584,14 @@ class MultiLayerNetwork(NetworkBase):
             if _is_recurrent(conf) and states[i] is None:
                 states[i] = {}
 
+        def cut_mask(m, sl):
+            if m is None:
+                return None
+            return m if m.ndim == 1 else m[:, sl]  # 1-D = per-example mask
+
         def cut(sl):
-            fm = None if ds.features_mask is None else ds.features_mask[:, sl]
-            lm = None if ds.labels_mask is None else ds.labels_mask[:, sl]
+            fm = cut_mask(ds.features_mask, sl)
+            lm = cut_mask(ds.labels_mask, sl)
             labels = ds.labels[:, sl] if ds.labels.ndim == 3 else ds.labels
             return (ds.features[:, sl], labels, fm, lm)
 
@@ -598,7 +607,7 @@ class MultiLayerNetwork(NetworkBase):
                 states, _ = self._fit_step(
                     *cut(slice(start, end)), stateful_states=states
                 )
-            self._notify(ds.num_examples())
+            self._notify(getattr(ds, "reported_examples", None) or ds.num_examples())
         # persist only non-RNN state (running stats); RNN carry is per-batch
         self.state_list = [
             st if not _is_recurrent(conf) else self.state_list[i]

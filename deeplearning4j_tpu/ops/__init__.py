@@ -7,3 +7,14 @@ Pallas functions that XLA fuses into whole-step programs.
 
 from deeplearning4j_tpu.ops.activations import Activation, activation_fn, register_activation
 from deeplearning4j_tpu.ops.losses import LossFunction, loss_value, register_loss
+from deeplearning4j_tpu.ops.helpers import (
+    get_helper,
+    helper_names,
+    register_helper,
+    set_helper_enabled,
+)
+
+try:  # vendor kernels register themselves; absence must never break ops/
+    from deeplearning4j_tpu.ops import pallas_lstm  # noqa: F401
+except Exception:  # pragma: no cover - pallas unavailable on this backend
+    pass

@@ -1,0 +1,67 @@
+"""Helper SPI — the vendor-kernel plugin point.
+
+Reference: the cuDNN Helper interfaces (ConvolutionHelper.java:35,
+BatchNormalizationHelper.java:29, ...) loaded reflectively by layer impls
+(ConvolutionLayer.java:68-72) with checkSupported() fallback to the
+built-in path. TPU-native shape: layers ask get_helper("op") before their
+default XLA lowering; a registered helper answers `supported(**ctx)` and,
+when true, its `fn` replaces the default. Pallas kernels register here
+(ops/pallas_lstm.py); anything unsupported falls back silently, exactly
+like the reference's cuDNN fallback.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Callable, Dict, Optional
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+
+@dataclasses.dataclass
+class Helper:
+    name: str
+    fn: Callable
+    supported: Callable[..., bool] = lambda **ctx: True
+    enabled: bool = True
+
+
+_HELPERS: Dict[str, Helper] = {}
+
+
+def register_helper(op: str, fn: Callable,
+                    supported: Optional[Callable[..., bool]] = None,
+                    name: Optional[str] = None) -> None:
+    """Install a helper for an op slot ("lstm_sequence", "conv2d", ...).
+    Last registration wins (the reference loads exactly one helper class
+    per layer type)."""
+    _HELPERS[op] = Helper(
+        name=name or getattr(fn, "__name__", op),
+        fn=fn,
+        supported=supported or (lambda **ctx: True),
+    )
+
+
+def get_helper(op: str, **ctx) -> Optional[Callable]:
+    """The helper's fn if one is registered, enabled, and supports this
+    call context; else None (caller uses its built-in path)."""
+    h = _HELPERS.get(op)
+    if h is None or not h.enabled:
+        return None
+    try:
+        if not h.supported(**ctx):
+            return None
+    except Exception as e:  # a broken probe must never kill the fallback
+        logger.warning("helper %s probe failed: %s", h.name, e)
+        return None
+    return h.fn
+
+
+def set_helper_enabled(op: str, enabled: bool) -> None:
+    if op in _HELPERS:
+        _HELPERS[op].enabled = bool(enabled)
+
+
+def helper_names() -> Dict[str, str]:
+    return {op: h.name for op, h in _HELPERS.items()}

@@ -218,6 +218,35 @@ class LossFunction:
     RMSE_XENT = "rmse_xent"
 
 
+def example_presence(per_ex, mask: Optional[jax.Array]):
+    """[batch] 0/1 presence from a labels mask: an example whose mask is
+    all-zero (a pad row from ParallelWrapper's pad-and-mask tail handling)
+    is absent. None mask -> all present."""
+    if mask is None:
+        return jnp.ones(per_ex.shape[0], per_ex.dtype)
+    m = mask
+    while m.ndim > 1:
+        m = jnp.max(m, axis=-1)
+    return (m > 0).astype(per_ex.dtype)
+
+
+def masked_example_mean(per_ex, mask: Optional[jax.Array]):
+    """Mean of per-example losses over PRESENT examples only. Identical to
+    jnp.mean when no example is fully masked; excludes zero-mask pad rows
+    so a padded tail batch yields exactly the unpadded score/gradients.
+
+    Intentional deviation from the reference: DL4J divides by the full
+    batch count even when sequences are fully masked, so batches with
+    more padding train with a silently smaller effective lr. Dividing by
+    the present count keeps the per-REAL-example gradient scale constant
+    across batches — and is what makes ParallelWrapper's pad-and-mask
+    tail numerically exact."""
+    if mask is None:
+        return jnp.mean(per_ex)
+    present = example_presence(per_ex, mask)
+    return jnp.sum(per_ex * present) / jnp.maximum(jnp.sum(present), 1.0)
+
+
 def loss_value(name: str, labels, preout, activation: str, mask: Optional[jax.Array] = None):
     """Per-example loss [batch] for the named loss function."""
     try:

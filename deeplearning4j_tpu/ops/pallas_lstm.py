@@ -1,0 +1,254 @@
+"""Fused Pallas LSTM sequence kernel — the CudnnHelper-equivalent.
+
+Why: the scan-based LSTM (nn/layers/recurrent.py) dispatches one tiny
+recurrent matmul per timestep; h/c round-trip HBM every step and nothing
+overlaps. Measured 0.7% MFU on the char-rnn bench (VERDICT weak #3) —
+exactly the case the reference hands to cuDNN's fused LSTM
+(deeplearning4j-cuda; SURVEY §7 stage 8). This kernel runs the WHOLE
+sequence in one pallas_call: grid over time, h/c/RW resident in VMEM
+across grid steps (TPU grids execute sequentially, scratch persists), so
+HBM traffic is just xg in / y out.
+
+Scope (checked by the helper probe, scan fallback otherwise): sigmoid
+gates + tanh cell, no peepholes, no time mask. Gate blocks [i,f,g,o] as in
+recurrent.py.
+
+Backward is a second reverse-time kernel (custom_vjp): recomputes c_t from
+saved post-activation gates, accumulates dRW in VMEM, emits per-step
+dgate-preactivations (dxg) from which autodiff outside the kernel derives
+dW/db/dx through the big batched input projection.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_INTERPRET = False  # flipped by tests on CPU
+
+
+def _fwd_kernel(xg_ref, rw_ref, h0_ref, c0_ref,
+                y_ref, acts_ref, hprev_ref, cprev_ref,
+                h_scr, c_scr):
+    t = pl.program_id(0)
+    H = h0_ref.shape[-1]
+
+    @pl.when(t == 0)
+    def _():
+        h_scr[:] = h0_ref[:].astype(jnp.float32)
+        c_scr[:] = c0_ref[:].astype(jnp.float32)
+
+    h = h_scr[:]
+    c = c_scr[:]
+    hprev_ref[0] = h.astype(hprev_ref.dtype)
+    cprev_ref[0] = c.astype(cprev_ref.dtype)
+
+    pre = xg_ref[0].astype(jnp.float32) + jnp.dot(
+        h, rw_ref[:].astype(jnp.float32),
+        preferred_element_type=jnp.float32)
+    i = jax.nn.sigmoid(pre[:, :H])
+    f = jax.nn.sigmoid(pre[:, H:2 * H])
+    g = jnp.tanh(pre[:, 2 * H:3 * H])
+    o = jax.nn.sigmoid(pre[:, 3 * H:])
+    c_new = f * c + i * g
+    h_new = o * jnp.tanh(c_new)
+
+    acts_ref[0] = jnp.concatenate([i, f, g, o], axis=-1).astype(acts_ref.dtype)
+    y_ref[0] = h_new.astype(y_ref.dtype)
+    h_scr[:] = h_new
+    c_scr[:] = c_new
+
+
+def _bwd_kernel(acts_ref, hprev_ref, cprev_ref, rw_ref,
+                dy_ref, dhF_ref, dcF_ref,
+                dxg_ref, drw_ref, dh0_ref, dc0_ref,
+                dh_scr, dc_scr, drw_scr):
+    k = pl.program_id(0)           # 0 .. T-1, walking time BACKWARD
+    T = pl.num_programs(0)
+    H = dh0_ref.shape[-1]
+
+    @pl.when(k == 0)
+    def _():
+        dh_scr[:] = dhF_ref[:].astype(jnp.float32)
+        dc_scr[:] = dcF_ref[:].astype(jnp.float32)
+        drw_scr[:] = jnp.zeros_like(drw_scr)
+
+    acts = acts_ref[0].astype(jnp.float32)
+    i, f = acts[:, :H], acts[:, H:2 * H]
+    g, o = acts[:, 2 * H:3 * H], acts[:, 3 * H:]
+    hprev = hprev_ref[0].astype(jnp.float32)
+    cprev = cprev_ref[0].astype(jnp.float32)
+
+    dh = dh_scr[:] + dy_ref[0].astype(jnp.float32)
+    c_t = f * cprev + i * g        # recomputed, not stored
+    tc = jnp.tanh(c_t)
+    do = dh * tc
+    dc = dh * o * (1.0 - tc * tc) + dc_scr[:]
+    di = dc * g
+    dg = dc * i
+    df = dc * cprev
+    dpre = jnp.concatenate([
+        di * i * (1.0 - i),
+        df * f * (1.0 - f),
+        dg * (1.0 - g * g),
+        do * o * (1.0 - o),
+    ], axis=-1)                                       # [B, 4H]
+
+    dxg_ref[0] = dpre.astype(dxg_ref.dtype)
+    drw_scr[:] += jnp.dot(hprev.T, dpre, preferred_element_type=jnp.float32)
+    dh_scr[:] = jnp.dot(dpre, rw_ref[:].astype(jnp.float32).T,
+                        preferred_element_type=jnp.float32)
+    dc_scr[:] = dc * f
+
+    @pl.when(k == T - 1)
+    def _():
+        drw_ref[:] = drw_scr[:].astype(drw_ref.dtype)
+        dh0_ref[:] = dh_scr[:].astype(dh0_ref.dtype)
+        dc0_ref[:] = dc_scr[:].astype(dc0_ref.dtype)
+
+
+def _fwd_call(xg, rw, h0, c0):
+    T, B, H4 = xg.shape
+    H = H4 // 4
+    dt = xg.dtype
+    y, acts, hprev, cprev = pl.pallas_call(
+        _fwd_kernel,
+        grid=(T,),
+        in_specs=[
+            pl.BlockSpec((1, B, H4), lambda t: (t, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((H, H4), lambda t: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((B, H), lambda t: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((B, H), lambda t: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, B, H), lambda t: (t, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, B, H4), lambda t: (t, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, B, H), lambda t: (t, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, B, H), lambda t: (t, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((T, B, H), dt),
+            jax.ShapeDtypeStruct((T, B, H4), dt),
+            jax.ShapeDtypeStruct((T, B, H), dt),
+            jax.ShapeDtypeStruct((T, B, H), dt),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((B, H), jnp.float32),
+            pltpu.VMEM((B, H), jnp.float32),
+        ],
+        interpret=_INTERPRET,
+    )(xg, rw, h0, c0)
+    return y, acts, hprev, cprev
+
+
+def _bwd_call(acts, hprev, cprev, rw, dy, dhF, dcF):
+    T, B, H4 = acts.shape
+    H = H4 // 4
+    dt = acts.dtype
+    rev = lambda t: (T - 1 - t, 0, 0)
+    dxg, drw, dh0, dc0 = pl.pallas_call(
+        _bwd_kernel,
+        grid=(T,),
+        in_specs=[
+            pl.BlockSpec((1, B, H4), rev, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, B, H), rev, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, B, H), rev, memory_space=pltpu.VMEM),
+            pl.BlockSpec((H, H4), lambda t: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, B, H), rev, memory_space=pltpu.VMEM),
+            pl.BlockSpec((B, H), lambda t: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((B, H), lambda t: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, B, H4), rev, memory_space=pltpu.VMEM),
+            pl.BlockSpec((H, H4), lambda t: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((B, H), lambda t: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((B, H), lambda t: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((T, B, H4), dt),
+            jax.ShapeDtypeStruct((H, H4), jnp.float32),
+            jax.ShapeDtypeStruct((B, H), dt),
+            jax.ShapeDtypeStruct((B, H), dt),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((B, H), jnp.float32),
+            pltpu.VMEM((B, H), jnp.float32),
+            pltpu.VMEM((H, H4), jnp.float32),
+        ],
+        interpret=_INTERPRET,
+    )(acts, hprev, cprev, rw, dy, dhF, dcF)
+    return dxg, drw, dh0, dc0
+
+
+@jax.custom_vjp
+def lstm_sequence(xg, rw, h0, c0):
+    """Fused LSTM over a whole sequence.
+
+    xg: [T, B, 4H] precomputed input projections + bias (time-major).
+    rw: [H, 4H] recurrent weights. h0/c0: [B, H].
+    Returns (y [T, B, H], hF, cF)."""
+    out, _ = _lstm_fwd(xg, rw, h0, c0)
+    return out
+
+
+def _lstm_fwd(xg, rw, h0, c0):
+    y, acts, hprev, cprev = _fwd_call(xg, rw, h0, c0)
+    H = rw.shape[0]
+    a_last = acts[-1].astype(jnp.float32)
+    cF = (a_last[:, H:2 * H] * cprev[-1].astype(jnp.float32)
+          + a_last[:, :H] * a_last[:, 2 * H:3 * H]).astype(y.dtype)
+    return (y, y[-1], cF), (acts, hprev, cprev, rw)
+
+
+def _lstm_bwd(res, cts):
+    acts, hprev, cprev, rw = res
+    dy, dhF, dcF = cts
+    # the hF cotangent folds into the last dy row; dcF enters the kernel
+    dy = dy.at[-1].add(dhF.astype(dy.dtype))
+    zero_h = jnp.zeros_like(dy[0])
+    dxg, drw, dh0, dc0 = _bwd_call(
+        acts, hprev, cprev, rw, dy, zero_h, dcF.astype(dy.dtype))
+    return dxg, drw.astype(rw.dtype), dh0, dc0
+
+
+lstm_sequence.defvjp(_lstm_fwd, _lstm_bwd)
+
+
+def supported(*, peephole, mask, gate_act, cell_act, reverse, **_):
+    """Helper probe: the fused kernel covers the standard configuration;
+    anything else falls back to the scan path (reference: cuDNN helper
+    checkSupported fallback)."""
+    if peephole or reverse or mask is not None:
+        return False
+    if gate_act not in ("sigmoid",) or cell_act not in ("tanh",):
+        return False
+    backend = jax.default_backend()
+    return backend == "tpu" or _INTERPRET
+
+
+def register():
+    from deeplearning4j_tpu.ops.helpers import register_helper
+
+    register_helper("lstm_sequence", lstm_sequence, supported,
+                    name="pallas_fused_lstm")
+
+
+register()

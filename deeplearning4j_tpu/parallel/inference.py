@@ -28,6 +28,7 @@ from deeplearning4j_tpu.parallel.mesh import (
     batch_sharded,
     data_parallel_mesh,
     data_shards,
+    pad_wrap,
     replicated,
 )
 
@@ -58,6 +59,7 @@ class ParallelInference:
             lambda a: jax.device_put(a, rep), model.params_list
         )
         self._q: "queue.Queue" = queue.Queue()
+        self._expected_shape = None  # set by the first request
         self._shutdown = False
         self._worker: Optional[threading.Thread] = None
         if self.mode == InferenceMode.BATCHED:
@@ -73,7 +75,20 @@ class ParallelInference:
         if self._shutdown:
             raise RuntimeError("ParallelInference has been shut down")
         xx = np.asarray(x)
+        if self._expected_shape is None:
+            self._expected_shape = xx.shape[1:]
+        elif xx.shape[1:] != self._expected_shape:
+            # validate HERE, not deep inside the collector where a bad
+            # request would fail the whole fused group
+            raise ValueError(
+                f"request feature shape {xx.shape[1:]} does not match this "
+                f"ParallelInference's {self._expected_shape}"
+            )
         if self.mode == InferenceMode.SEQUENTIAL:
+            return self._run(xx)
+        if xx.shape[0] > self.max_batch_size:
+            # oversized request: run it alone instead of overshooting a
+            # fused group arbitrarily
             return self._run(xx)
         fut: Future = Future()
         self._q.put((xx, fut))
@@ -99,12 +114,15 @@ class ParallelInference:
     # -- internals -----------------------------------------------------------
 
     def _run(self, xx: np.ndarray):
-        sh = (
-            batch_sharded(self.mesh)
-            if xx.shape[0] % self.n_shards == 0
-            else replicated(self.mesh)
-        )
-        return self.model.output(jax.device_put(xx, sh))
+        """Sharded forward; non-divisible batches are padded by wrapping
+        and sliced — sharded execution with a stable trace shape instead
+        of a replicated fallback."""
+        n = xx.shape[0]
+        pad = (-n) % self.n_shards
+        if pad:
+            xx = pad_wrap(xx, self.n_shards)
+        out = self.model.output(jax.device_put(xx, batch_sharded(self.mesh)))
+        return out[:n] if pad else out
 
     def _collector(self):
         while not self._shutdown:

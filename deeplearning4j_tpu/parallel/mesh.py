@@ -71,3 +71,13 @@ def placement_for_batch(mesh: Mesh, n_examples: int) -> NamedSharding:
     if n_examples % data_shards(mesh) == 0:
         return batch_sharded(mesh)
     return replicated(mesh)
+
+
+def pad_wrap(a: np.ndarray, multiple: int) -> np.ndarray:
+    """Pad dim 0 up to the next multiple by cyclically repeating examples
+    (np.resize wraps, correct even when the pad exceeds the batch). Used
+    by every pad-and-slice serving/training path so the policy lives in
+    one place."""
+    n = a.shape[0]
+    pad = (-n) % multiple
+    return np.resize(a, (n + pad,) + a.shape[1:]) if pad else a

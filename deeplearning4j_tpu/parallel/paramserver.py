@@ -1,0 +1,188 @@
+"""Asynchronous parameter server for embedding training (DCN path).
+
+Design (the written PS/embedding-async plan; reference:
+ParameterServerTrainer.java:32-66 pushNDArray over Aeron,
+SparkSequenceVectors.java:292-294 VoidParameterServer):
+
+Why a PS at all, when gradient allreduce covers dense training? Embedding
+workloads touch a SPARSE, tiny slice of an enormous table each step;
+allreducing a dense table-sized gradient per step is absurd, and the
+hot-word rows tolerate stale updates (async SGD is the reference's own
+semantics — it documents the nondeterminism, DeepWalk.java:223). So:
+
+  server:  row-sharded tables (syn0/syn1/syn1neg) in host memory, one
+           process per DCN endpoint; applies row DELTAS in arrival order
+           (Hogwild-style), serves row PULLS. HTTP here; the transport is
+           the pluggable part (the reference swapped Aeron in the same
+           slot) — gRPC/DCN drops into _Transport without touching
+           trainer logic.
+  client:  per-batch: PULL the rows the batch touches, run the jitted
+           device skip-gram/CBOW step (nlp/learning.py — the
+           AggregateSkipGram analog) on those rows only, PUSH back the
+           row deltas fire-and-forget on a bounded queue.
+  sharding: row id -> shard by modulo over server endpoints; each
+           endpoint owns rows i with i % n_servers == k, so pushes from
+           all workers for one row serialize at one owner (no
+           cross-server coordination).
+
+Staleness bound: one in-flight push window per worker (the queue), i.e.
+a worker's pulls lag its own pushes by <= queue depth; convergence for
+embedding objectives is unaffected in practice (the reference ships the
+same tradeoff).
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+class EmbeddingParameterServer:
+    """One shard-owner process. Tables are {name: [rows, dim]} float32."""
+
+    def __init__(self, tables: Dict[str, np.ndarray], port: int = 0):
+        self.tables = {k: np.asarray(v, np.float32) for k, v in tables.items()}
+        self._locks = {k: threading.Lock() for k in self.tables}
+        self.port = int(port)
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self.pushes_applied = 0
+
+    # -- core ops ------------------------------------------------------------
+
+    def pull(self, name: str, rows: List[int]) -> np.ndarray:
+        with self._locks[name]:
+            return self.tables[name][rows].copy()
+
+    def push(self, name: str, rows: List[int], deltas: np.ndarray) -> None:
+        """Apply row deltas in arrival order (async SGD)."""
+        with self._locks[name]:
+            np.add.at(self.tables[name], rows, deltas)
+            self.pushes_applied += 1
+
+    # -- http transport ------------------------------------------------------
+
+    def start(self) -> int:
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                try:
+                    body = json.loads(self.rfile.read(n))
+                    name = body["table"]
+                    rows = body["rows"]
+                    if self.path == "/pull":
+                        out = outer.pull(name, rows)
+                        payload = json.dumps(
+                            {"data": out.tolist()}).encode()
+                    elif self.path == "/push":
+                        outer.push(name, rows,
+                                   np.asarray(body["deltas"], np.float32))
+                        payload = b'{"status":"ok"}'
+                    else:
+                        self.send_response(404)
+                        self.end_headers()
+                        return
+                    self.send_response(200)
+                except (KeyError, ValueError, IndexError) as e:
+                    payload = json.dumps({"error": str(e)}).encode()
+                    self.send_response(400)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port), Handler)
+        self.port = self._httpd.server_address[1]
+        threading.Thread(target=self._httpd.serve_forever,
+                         daemon=True).start()
+        return self.port
+
+    def stop(self):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+
+
+class EmbeddingPSClient:
+    """Worker-side pull/push. Pushes ride a bounded background queue
+    (fire-and-forget, the Aeron pushNDArray analog); pulls are
+    synchronous (the step needs the rows)."""
+
+    def __init__(self, urls: List[str], queue_size: int = 64,
+                 timeout: float = 10.0):
+        self.urls = [u.rstrip("/") for u in urls]
+        self.timeout = timeout
+        self._q: "queue.Queue" = queue.Queue(maxsize=queue_size)
+        self._worker = threading.Thread(target=self._drain, daemon=True)
+        self._worker.start()
+
+    def _owner(self, row: int) -> int:
+        return row % len(self.urls)
+
+    def _post(self, url: str, route: str, body: dict) -> dict:
+        req = urllib.request.Request(
+            f"{url}{route}", data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=self.timeout) as r:
+            return json.loads(r.read())
+
+    def pull(self, table: str, rows: np.ndarray) -> np.ndarray:
+        """Fetch rows (grouped per owning shard, order restored)."""
+        rows = np.asarray(rows, np.int64)
+        out: Optional[np.ndarray] = None
+        for s, url in enumerate(self.urls):
+            sel = np.nonzero(rows % len(self.urls) == s)[0]
+            if sel.size == 0:
+                continue
+            got = np.asarray(self._post(url, "/pull", {
+                "table": table, "rows": rows[sel].tolist()})["data"],
+                np.float32)
+            if out is None:
+                out = np.zeros((rows.size, got.shape[1]), np.float32)
+            out[sel] = got
+        return out
+
+    def push_async(self, table: str, rows: np.ndarray,
+                   deltas: np.ndarray) -> None:
+        try:
+            self._q.put_nowait((table, np.asarray(rows, np.int64),
+                                np.asarray(deltas, np.float32)))
+        except queue.Full:
+            # backpressure: block — dropping would lose gradient mass
+            self._q.put((table, np.asarray(rows, np.int64),
+                         np.asarray(deltas, np.float32)))
+
+    def _drain(self):
+        while True:
+            table, rows, deltas = self._q.get()
+            try:
+                for s, url in enumerate(self.urls):
+                    sel = np.nonzero(rows % len(self.urls) == s)[0]
+                    if sel.size == 0:
+                        continue
+                    self._post(url, "/push", {
+                        "table": table, "rows": rows[sel].tolist(),
+                        "deltas": deltas[sel].tolist()})
+            except OSError:
+                pass  # endpoint down: drop this push, keep training
+            finally:
+                self._q.task_done()
+
+    def flush(self, timeout: float = 30.0):
+        import time
+
+        deadline = time.monotonic() + timeout
+        while not self._q.empty() and time.monotonic() < deadline:
+            time.sleep(0.02)
+        self._q.join()

@@ -40,6 +40,7 @@ from deeplearning4j_tpu.data.iterators import DataSetIterator, StackedDataSetIte
 from deeplearning4j_tpu.parallel.mesh import (
     data_parallel_mesh,
     data_shards,
+    pad_wrap,
     placement_for_batch,
     replicated,
 )
@@ -83,6 +84,7 @@ class ParallelWrapper:
         self.workers = int(workers)
         self.prefetch_buffer = prefetch_buffer
         self.n_shards = data_shards(self.mesh)
+        self._pad_target = 0  # largest shard-divisible batch seen
         model._require_init()
         self._place_replicated()
 
@@ -102,23 +104,64 @@ class ParallelWrapper:
 
     def _shard_batch(self, ds):
         """Shard a global batch's dim 0 across the data axis (DataSet or
-        MultiDataSet — ComputationGraph fit yields the latter)."""
-        sh = placement_for_batch(self.mesh, ds.num_examples())
-        put = lambda a: None if a is None else jax.device_put(np.asarray(a), sh)
+        MultiDataSet — ComputationGraph fit yields the latter).
+
+        Pad-and-mask tail handling: a batch not divisible by the shard
+        count is padded to the next multiple by WRAPPING examples (repeat
+        from the batch start) and the pad rows are excluded from the loss
+        via an all-zero labels-mask row (losses use masked_example_mean,
+        so the padded step computes exactly the unpadded score/gradients).
+        A labels mask of ones is supplied for full batches too, keeping
+        one trace signature — the tail batch neither recompiles nor drops
+        to replicated serial execution (round-2 weakness: a 255-example
+        tail on 8 devices ran 8x redundant AND recompiled). Note: wrapped
+        pad rows do still enter batch-norm batch statistics — a stochastic
+        duplicate-sample effect on the tail step only."""
+        n = ds.num_examples()
+        # pad up to the largest (shard-divisible) batch seen so far, so a
+        # short tail reuses the full batches' compiled executable instead
+        # of introducing a second shape
+        target = max(n + ((-n) % self.n_shards), self._pad_target)
+        self._pad_target = target
+        pad = target - n
+
+        def wrap(a):
+            return None if a is None else pad_wrap(np.asarray(a), target)
+
+        def pad_lmask(lm):
+            """Existing labels mask: pad rows of zeros. Absent: 0/1 vector."""
+            if lm is not None:
+                lm = np.asarray(lm)
+                z = np.zeros((pad,) + lm.shape[1:], lm.dtype)
+                return np.concatenate([lm, z]) if pad else lm
+            m = np.ones((n + pad,), np.float32)
+            if pad:
+                m[n:] = 0.0
+            return m
+
+        sh = placement_for_batch(self.mesh, n + pad)
+        put = lambda a: None if a is None else jax.device_put(a, sh)
         if isinstance(ds, MultiDataSet):
-            put_list = lambda arrs: None if arrs is None else [put(a) for a in arrs]
-            return MultiDataSet(
-                [put(f) for f in ds.features],
-                [put(l) for l in ds.labels],
-                put_list(ds.features_masks),
-                put_list(ds.labels_masks),
+            lmasks = ds.labels_masks
+            if lmasks is None:
+                lmasks = [None] * len(ds.labels)
+            out = MultiDataSet(
+                [put(wrap(f)) for f in ds.features],
+                [put(wrap(l)) for l in ds.labels],
+                None if ds.features_masks is None
+                else [put(wrap(m)) for m in ds.features_masks],
+                [put(pad_lmask(m)) for m in lmasks],
             )
-        return DataSet(
-            put(ds.features),
-            put(ds.labels),
-            put(ds.features_mask),
-            put(ds.labels_mask),
-        )
+        else:
+            out = DataSet(
+                put(wrap(ds.features)),
+                put(wrap(ds.labels)),
+                put(wrap(ds.features_mask)),
+                put(pad_lmask(ds.labels_mask)),
+            )
+        # listeners/counters must see the REAL example count, not the pad
+        out.reported_examples = n
+        return out
 
     # -- training ------------------------------------------------------------
 
@@ -134,6 +177,9 @@ class ParallelWrapper:
             if not isinstance(data, DataSetIterator):
                 raise ValueError("workers > 1 requires a DataSetIterator input")
             data_in = StackedDataSetIterator(data, self.workers)
+        # the pad-up-to target is per-fit state: a later fit with a smaller
+        # batch size must not keep padding to the old larger shape
+        self._pad_target = 0
         prev_transform = net._batch_transform
         net._batch_transform = self._shard_batch
         try:
@@ -147,7 +193,14 @@ class ParallelWrapper:
 
     def output(self, x):
         """Data-parallel forward pass: shards the batch, same replicated
-        params."""
+        params. Non-divisible batches are padded by wrapping and the pad
+        rows sliced off the result — sharded execution and a stable trace
+        shape instead of the replicated fallback."""
         xx = np.asarray(x)
+        n = xx.shape[0]
+        pad = (-n) % self.n_shards
+        if pad:
+            xx = pad_wrap(xx, self.n_shards)
         sh = placement_for_batch(self.mesh, xx.shape[0])
-        return self.model.output(jax.device_put(xx, sh))
+        out = self.model.output(jax.device_put(xx, sh))
+        return out[:n] if pad else out

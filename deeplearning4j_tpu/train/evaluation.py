@@ -13,10 +13,22 @@ holding activations.
 
 from __future__ import annotations
 
-from typing import Optional
+import dataclasses
+from typing import Any, List, Optional
 
 import jax.numpy as jnp
 import numpy as np
+
+
+@dataclasses.dataclass
+class Prediction:
+    """Per-example record metadata (reference: eval/meta/Prediction.java —
+    actual/predicted class plus the caller's record metadata, for
+    inspecting which examples were misclassified)."""
+
+    actual: int
+    predicted: int
+    record_meta: Any = None
 
 
 class IEvaluation:
@@ -33,22 +45,35 @@ class Evaluation(IEvaluation):
     """Multi-class classification evaluation over one-hot (or probability)
     labels/predictions."""
 
-    def __init__(self, num_classes: Optional[int] = None, labels_list=None):
+    def __init__(self, num_classes: Optional[int] = None, labels_list=None,
+                 top_n: int = 1):
         self.num_classes = num_classes
         self.labels_list = labels_list
         self.confusion: Optional[np.ndarray] = None  # [true, predicted]
+        # top-N accuracy (reference: Evaluation(int topN), topNAccuracy())
+        self.top_n = max(1, int(top_n))
+        self.top_n_correct = 0
+        self.top_n_total = 0
+        # per-example record metadata (reference: Evaluation record-meta
+        # overloads + eval/meta/Prediction.java)
+        self.predictions: List[Prediction] = []
 
     def _ensure(self, n):
         if self.confusion is None:
             self.num_classes = n
             self.confusion = np.zeros((n, n), dtype=np.int64)
 
-    def eval_batch(self, labels, predictions, mask=None):
+    def eval_batch(self, labels, predictions, mask=None, record_meta=None):
         """labels/predictions: [batch, nClasses] (or [batch, time, nClasses]
         with optional [batch, time] mask — time-distributed evaluation as in
-        the reference's evalTimeSeries)."""
+        the reference's evalTimeSeries). record_meta: optional per-example
+        metadata sequence (one entry per EXAMPLE; for time-series labels
+        each entry covers all of that example's timesteps); kept with each
+        prediction for error inspection (reference: evaluate(iter,
+        metaData))."""
         labels = jnp.asarray(labels)
         predictions = jnp.asarray(predictions)
+        time_steps = labels.shape[1] if labels.ndim == 3 else 1
         if labels.ndim == 3:
             n = labels.shape[-1]
             labels = labels.reshape(-1, n)
@@ -61,10 +86,43 @@ class Evaluation(IEvaluation):
             flat = np.asarray(mask).reshape(-1) > 0 if mask is not None else None
         t = np.asarray(jnp.argmax(labels, axis=-1))
         p = np.asarray(jnp.argmax(predictions, axis=-1))
+        probs = np.asarray(predictions)
         if flat is not None:
-            t, p = t[flat], p[flat]
+            t, p, probs = t[flat], p[flat], probs[flat]
         self._ensure(int(labels.shape[-1]))
         np.add.at(self.confusion, (t, p), 1)
+        if self.top_n > 1:
+            k = min(self.top_n, probs.shape[-1])
+            topk = np.argpartition(-probs, k - 1, axis=-1)[:, :k]
+            self.top_n_correct += int((topk == t[:, None]).any(axis=1).sum())
+            self.top_n_total += t.size
+        if record_meta is not None:
+            metas = [m for m in record_meta for _ in range(time_steps)]
+            if len(metas) != (flat.size if flat is not None else t.size):
+                raise ValueError(
+                    f"record_meta has {len(metas) // time_steps} entries "
+                    f"for a batch of "
+                    f"{(flat.size if flat is not None else t.size) // time_steps}")
+            if flat is not None:
+                metas = [m for m, keep in zip(metas, flat) if keep]
+            for ti, pi, m in zip(t, p, metas):
+                self.predictions.append(Prediction(int(ti), int(pi), m))
+
+    def top_n_accuracy(self) -> float:
+        if self.top_n == 1:
+            return self.accuracy()
+        return (self.top_n_correct / self.top_n_total
+                if self.top_n_total else 0.0)
+
+    def get_prediction_errors(self) -> List[Prediction]:
+        """Misclassified examples with their metadata (reference:
+        Evaluation.getPredictionErrors)."""
+        return [p for p in self.predictions if p.actual != p.predicted]
+
+    def get_predictions(self, actual_cls: int,
+                        predicted_cls: int) -> List[Prediction]:
+        return [p for p in self.predictions
+                if p.actual == actual_cls and p.predicted == predicted_cls]
 
     # alias matching the reference API
     eval = eval_batch
@@ -73,6 +131,9 @@ class Evaluation(IEvaluation):
         if other.confusion is not None:
             self._ensure(other.confusion.shape[0])
             self.confusion += other.confusion
+        self.top_n_correct += other.top_n_correct
+        self.top_n_total += other.top_n_total
+        self.predictions += other.predictions
         return self
 
     # -- metrics -------------------------------------------------------------
@@ -274,6 +335,41 @@ class ROCMultiClass(IEvaluation):
 
     def calculate_auc(self, cls: int) -> float:
         return self.per_class[cls].calculate_auc()
+
+
+class ROCBinary(IEvaluation):
+    """Independent per-output-column binary ROC (reference:
+    eval/ROCBinary.java — multi-label outputs, one ROC per column, with
+    optional per-example mask)."""
+
+    def __init__(self):
+        self.per_column = {}
+
+    def eval_batch(self, labels, predictions, mask=None):
+        l = np.asarray(labels, dtype=np.float64)
+        p = np.asarray(predictions, dtype=np.float64)
+        if l.ndim == 3:
+            l = l.reshape(-1, l.shape[-1])
+            p = p.reshape(-1, p.shape[-1])
+        if mask is not None:
+            m = np.asarray(mask).reshape(-1) > 0
+            l, p = l[m], p[m]
+        for c in range(l.shape[-1]):
+            roc = self.per_column.setdefault(c, ROC())
+            roc.eval_batch(l[:, c], p[:, c])
+
+    eval = eval_batch
+
+    def merge(self, other: "ROCBinary"):
+        for c, roc in other.per_column.items():
+            if c in self.per_column:
+                self.per_column[c].merge(roc)
+            else:
+                self.per_column[c] = roc
+        return self
+
+    def calculate_auc(self, col: int = 0) -> float:
+        return self.per_column[col].calculate_auc()
 
 
 class EvaluationBinary(IEvaluation):

@@ -1,0 +1,73 @@
+"""Worker process for tests/test_multihost.py — one simulated host.
+
+Invoked as:
+    python multihost_worker.py <coordinator> <num_procs> <proc_id> <out.npz>
+with XLA_FLAGS=--xla_force_host_platform_device_count=4, so 2 processes x
+4 virtual CPU devices = one 8-device global mesh over "DCN"."""
+
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+# match tests/conftest.py so worker numerics are comparable to the
+# in-process baseline
+jax.config.update("jax_default_matmul_precision", "highest")
+
+import numpy as np  # noqa: E402
+
+
+def main():
+    coordinator, num_procs, proc_id, out_path = (
+        sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), sys.argv[4])
+
+    from deeplearning4j_tpu.parallel.multihost import (
+        MultiHostDataParallel,
+        global_data_parallel_mesh,
+        initialize_distributed,
+    )
+
+    initialize_distributed(coordinator, num_procs, proc_id)
+    assert jax.device_count() == 8, jax.device_count()
+    assert jax.local_device_count() == 4
+
+    from tests.multihost_common import build_net, global_data
+
+    x, y = global_data()
+    # each GLOBAL batch of 16 splits between the processes: this process
+    # contributes rows [g*16 + proc*8, g*16 + proc*8 + 8) of batch g
+    global_batch, local_batch = 16, 16 // num_procs
+    rows = np.concatenate([
+        np.arange(g + proc_id * local_batch,
+                  g + (proc_id + 1) * local_batch)
+        for g in range(0, x.shape[0], global_batch)
+    ])
+    x_local, y_local = x[rows], y[rows]
+
+    net = build_net()
+    mesh = global_data_parallel_mesh()
+    trainer = MultiHostDataParallel(net, mesh)
+    trainer.fit_local_shards(
+        _local_iter(x_local, y_local, batch=local_batch), epochs=2)
+
+    if proc_id == 0:
+        flat = {}
+        for i, p in enumerate(net.params_list):
+            for k, v in p.items():
+                flat[f"{i}/{k}"] = np.asarray(v)
+        np.savez(out_path, **flat)
+    # all processes must exit cleanly together
+    jax.effects_barrier()
+
+
+def _local_iter(x, y, batch):
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.data.iterators import ExistingDataSetIterator
+
+    dss = [DataSet(x[i:i + batch], y[i:i + batch])
+           for i in range(0, x.shape[0], batch)]
+    return ExistingDataSetIterator(dss)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,227 @@
+"""Dataset fetchers, record-reader bridge, streaming ingestion, CLI,
+keras-backend entry point (SURVEY rows 21/30/31/33)."""
+
+import io
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data.fetchers import (
+    CifarDataFetcher,
+    CifarDataSetIterator,
+    IrisDataSetIterator,
+    iris_data,
+)
+from deeplearning4j_tpu.data.records import (
+    CollectionRecordReader,
+    CSVRecordReader,
+    RecordReaderDataSetIterator,
+)
+from deeplearning4j_tpu.data.streaming import StreamingDataSetIterator
+
+
+def test_cifar_synthetic_fallback_shapes():
+    f = CifarDataFetcher(allow_download=False, synthetic_n=128)
+    it = CifarDataSetIterator(32, train=True, fetcher=f)
+    assert it.source == "synthetic"
+    batches = list(it)
+    assert len(batches) == 4
+    assert batches[0].features.shape == (32, 32, 32, 3)
+    assert batches[0].labels.shape == (32, 10)
+    # deterministic across constructions
+    f2 = CifarDataFetcher(allow_download=False, synthetic_n=128)
+    x2, _ = f2.load(train=True)
+    np.testing.assert_array_equal(batches[0].features, x2[:32])
+
+
+def test_cifar_synthetic_is_learnable():
+    """The synthetic gratings are class-separable by a small conv net —
+    the property that makes the fallback a faithful pipeline stand-in."""
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    from deeplearning4j_tpu.nn.conf.layers import (
+        ConvolutionLayer, GlobalPoolingLayer, OutputLayer)
+    from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    f = CifarDataFetcher(allow_download=False, synthetic_n=512)
+    x, y = f.load(train=True)
+    conf = (NeuralNetConfiguration.builder().seed(1).updater("adam")
+            .learning_rate(3e-3).weight_init("relu").list()
+            .layer(ConvolutionLayer(n_out=24, kernel_size=(5, 5),
+                                    stride=(2, 2), activation="relu",
+                                    convolution_mode="same"))
+            .layer(ConvolutionLayer(n_out=32, kernel_size=(3, 3),
+                                    stride=(2, 2), activation="relu",
+                                    convolution_mode="same"))
+            .layer(GlobalPoolingLayer(pooling_type="avg"))
+            .layer(OutputLayer(n_out=10, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.convolutional(32, 32, 3)).build())
+    net = MultiLayerNetwork(conf).init()
+    net.fit(x, y, batch_size=64, epochs=14, async_prefetch=False)
+    acc = net.evaluate(
+        __import__("deeplearning4j_tpu.data.iterators",
+                   fromlist=["ListDataSetIterator"]).ListDataSetIterator(
+            __import__("deeplearning4j_tpu.data.dataset",
+                       fromlist=["DataSet"]).DataSet(x, y), 128)).accuracy()
+    assert acc > 0.6, acc
+
+
+def test_iris_iterator():
+    it = IrisDataSetIterator(50)
+    batches = list(it)
+    assert len(batches) == 3
+    x, y = iris_data()
+    assert x.shape == (150, 4) and y.shape == (150, 3)
+    # deterministic + balanced
+    assert y.sum(axis=0).tolist() == [50.0, 50.0, 50.0]
+    x2, _ = iris_data()
+    np.testing.assert_array_equal(x, x2)
+
+
+def test_csv_record_reader_classification():
+    csv_text = "sepal_l,sepal_w,label\n" + "\n".join(
+        f"{i / 10:.1f},{(i * 3 % 7) / 10:.1f},{i % 3}" for i in range(10))
+    reader = CSVRecordReader(io.StringIO(csv_text), skip_lines=1)
+    it = RecordReaderDataSetIterator(reader, batch_size=4, label_index=2,
+                                    num_classes=3)
+    batches = list(it)
+    assert [b.features.shape[0] for b in batches] == [4, 4, 2]
+    assert batches[0].features.shape[1] == 2
+    assert batches[0].labels.shape == (4, 3)
+    np.testing.assert_allclose(batches[0].features[1], [0.1, 0.3])
+    assert batches[0].labels[1].argmax() == 1
+    # iterating again re-reads the source
+    assert len(list(it)) == 3
+
+
+def test_record_reader_regression_and_validation():
+    recs = [[1.0, 2.0, 3.0, 4.0], [5.0, 6.0, 7.0, 8.0]]
+    it = RecordReaderDataSetIterator(
+        CollectionRecordReader(recs), 2,
+        label_index_from=2, label_index_to=3)
+    b = next(iter(it))
+    np.testing.assert_allclose(b.features, [[1, 2], [5, 6]])
+    np.testing.assert_allclose(b.labels, [[3, 4], [7, 8]])
+    with pytest.raises(ValueError):
+        RecordReaderDataSetIterator(CollectionRecordReader(recs), 2)
+
+
+def test_streaming_iterator_backpressure_and_training():
+    produced = []
+
+    def gen():
+        rng = np.random.default_rng(0)
+        for _ in range(6):
+            x = rng.standard_normal((8, 4)).astype(np.float32)
+            y = np.zeros((8, 2), np.float32)
+            y[np.arange(8), rng.integers(0, 2, 8)] = 1.0
+            produced.append(x)
+            yield x, y
+
+    it = StreamingDataSetIterator(gen(), buffer_size=2)
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    conf = (NeuralNetConfiguration.builder().seed(0).list()
+            .layer(DenseLayer(n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4)).build())
+    net = MultiLayerNetwork(conf).init()
+    net.fit(it, epochs=1, async_prefetch=False)
+    assert net.iteration == 6
+    # a stream has no beginning to rewind to: reuse raises
+    with pytest.raises(RuntimeError, match="already consumed"):
+        list(it)
+
+
+def test_streaming_iterator_propagates_source_error():
+    def bad():
+        yield (np.zeros((2, 4), np.float32), np.zeros((2, 2), np.float32))
+        raise OSError("kafka broke")
+
+    it = StreamingDataSetIterator(bad())
+    with pytest.raises(OSError, match="kafka broke"):
+        list(it)
+
+
+def test_cli_train_evaluate_round_trip(tmp_path, capsys):
+    from deeplearning4j_tpu.cli import main
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.utils.model_serializer import save_model
+
+    conf = (NeuralNetConfiguration.builder().seed(2).updater("adam")
+            .learning_rate(0.05).list()
+            .layer(DenseLayer(n_out=16, activation="tanh"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4)).build())
+    model_path = str(tmp_path / "iris_model.zip")
+    save_model(MultiLayerNetwork(conf).init(), model_path)
+
+    out_path = str(tmp_path / "trained.zip")
+    rc = main(["train", "--model-path", model_path, "--data", "iris",
+               "--epochs", "30", "--batch-size", "32",
+               "--output", out_path])
+    assert rc == 0
+    rc = main(["evaluate", "--model-path", out_path, "--data", "iris"])
+    assert rc == 0
+    stats = capsys.readouterr().out
+    acc = float(stats.split("Accuracy:")[1].split()[0])
+    assert acc > 0.9, stats
+
+
+def test_keras_backend_server(tmp_path):
+    from deeplearning4j_tpu.keras_backend import KerasBackendServer
+
+    model_config = json.dumps({
+        "class_name": "Sequential",
+        "config": [
+            {"class_name": "Dense",
+             "config": {"output_dim": 12, "activation": "tanh",
+                        "batch_input_shape": [None, 6], "name": "d1"}},
+            {"class_name": "Dense",
+             "config": {"output_dim": 2, "activation": "softmax",
+                        "name": "d2"}},
+        ],
+    })
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((64, 6)).astype(np.float32)
+    y = np.zeros((64, 2), np.float32)
+    y[np.arange(64), (x[:, 0] > 0).astype(int)] = 1.0
+    np.save(tmp_path / "x.npy", x)
+    np.save(tmp_path / "y.npy", y)
+
+    server = KerasBackendServer(port=0)
+    port = server.start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/fit",
+            data=json.dumps({
+                "model_config": model_config,
+                "features_path": str(tmp_path / "x.npy"),
+                "labels_path": str(tmp_path / "y.npy"),
+                "batch_size": 16, "nb_epoch": 20,
+            }).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req) as r:
+            out = json.loads(r.read())
+        assert np.isfinite(out["score"])
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/evaluate",
+            data=json.dumps({
+                "features_path": str(tmp_path / "x.npy"),
+                "labels_path": str(tmp_path / "y.npy"),
+            }).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req) as r:
+            ev = json.loads(r.read())
+        assert ev["accuracy"] > 0.8
+    finally:
+        server.stop()

@@ -1,0 +1,69 @@
+"""Graph package: Graph/walkers/DeepWalk (reference: deeplearning4j-graph
+tests — walk validity, embedding quality on a clustered graph)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.graph import DeepWalk, Graph, RandomWalkIterator
+from deeplearning4j_tpu.graph.walkers import NoEdgeHandling
+
+
+def _two_cliques(k=6, bridge=True):
+    """Two k-cliques joined by one bridge edge."""
+    g = Graph(2 * k)
+    for base in (0, k):
+        for i in range(k):
+            for j in range(i + 1, k):
+                g.add_edge(base + i, base + j)
+    if bridge:
+        g.add_edge(k - 1, k)
+    return g
+
+
+def test_graph_and_walks():
+    g = _two_cliques()
+    assert g.degree(0) == 5
+    walks = list(RandomWalkIterator(g, walk_length=10, seed=1))
+    assert len(walks) == 12
+    for w in walks:
+        assert len(w) == 11
+        for a, b in zip(w, w[1:]):
+            assert b in g.neighbors(a), f"invalid hop {a}->{b}"
+
+
+def test_dead_end_handling():
+    g = Graph(2, directed=True)
+    g.add_edge(0, 1)  # vertex 1 has no outgoing edge
+    it = RandomWalkIterator(g, 4, seed=0,
+                            no_edge_handling=NoEdgeHandling.SELF_LOOP)
+    w = it.walk_from(0)
+    assert len(w) == 5 and w[-1] == 1  # parked at the sink
+    it = RandomWalkIterator(g, 4, seed=0,
+                            no_edge_handling=NoEdgeHandling.CUTOFF)
+    assert it.walk_from(0) == [0, 1]
+    it = RandomWalkIterator(g, 4, seed=0,
+                            no_edge_handling=NoEdgeHandling.EXCEPTION)
+    with pytest.raises(RuntimeError):
+        it.walk_from(0)
+
+
+def test_deepwalk_separates_cliques():
+    g = _two_cliques(k=6)
+    dw = DeepWalk(vector_size=16, window_size=4, walks_per_vertex=8,
+                  learning_rate=0.05, seed=3, batch_size=512)
+    vectors = dw.fit(g, walk_length=20)
+    # intra-clique similarity dominates inter-clique (skip the bridge
+    # endpoints, whose walks straddle both cliques)
+    intra, inter = [], []
+    for a in range(0, 4):
+        for b in range(1, 4):
+            if a != b:
+                intra.append(vectors.similarity(a, b))
+        for b in range(6, 10):
+            inter.append(vectors.similarity(a, b))
+    assert np.mean(intra) > np.mean(inter) + 0.2, (
+        np.mean(intra), np.mean(inter))
+    # nearest neighbors of a clique-0 vertex are in clique 0
+    near = vectors.verts_nearest(1, top_n=3)
+    assert all(v < 6 for v in near), near
+    assert vectors.vertex_vector(0).shape == (16,)

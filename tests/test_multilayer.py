@@ -162,3 +162,51 @@ def test_score_matches_manual_crossentropy():
     out = np.asarray(net.output(x))
     manual = -np.mean(np.sum(y * np.log(np.clip(out, 1e-8, None)), axis=-1))
     assert abs(net.score(x, y) - manual) < 1e-4
+
+
+# -- evaluation extensions (top-N, ROCBinary, prediction metadata) -----------
+
+def test_topn_accuracy_and_prediction_meta():
+    from deeplearning4j_tpu.train.evaluation import Evaluation
+
+    ev = Evaluation(top_n=2)
+    labels = np.eye(4, dtype=np.float32)[[0, 1, 2, 3]]
+    # top-1 correct only for example 0; top-2 correct for 0,1,2
+    preds = np.array([
+        [0.9, 0.05, 0.03, 0.02],
+        [0.5, 0.4, 0.05, 0.05],
+        [0.1, 0.2, 0.3, 0.4],
+        [0.4, 0.3, 0.2, 0.1],
+    ], np.float32)
+    ev.eval_batch(labels, preds, record_meta=["a", "b", "c", "d"])
+    assert ev.accuracy() == 0.25
+    assert ev.top_n_accuracy() == 0.75
+    errs = ev.get_prediction_errors()
+    assert [e.record_meta for e in errs] == ["b", "c", "d"]
+    assert ev.get_predictions(1, 0)[0].record_meta == "b"
+    # merge keeps the counters
+    ev2 = Evaluation(top_n=2)
+    ev2.eval_batch(labels, labels, record_meta=list("wxyz"))
+    ev.merge(ev2)
+    assert ev.top_n_accuracy() == (3 + 4) / 8
+
+
+def test_roc_binary_per_column():
+    from deeplearning4j_tpu.train.evaluation import ROCBinary
+
+    rng = np.random.default_rng(0)
+    n = 400
+    labels = (rng.random((n, 2)) > 0.5).astype(np.float64)
+    # column 0: informative scores; column 1: pure noise
+    scores = np.stack([
+        0.7 * labels[:, 0] + 0.3 * rng.random(n),
+        rng.random(n),
+    ], axis=1)
+    roc = ROCBinary()
+    # feed in two halves and also exercise merge
+    roc.eval_batch(labels[:200], scores[:200])
+    other = ROCBinary()
+    other.eval_batch(labels[200:], scores[200:])
+    roc.merge(other)
+    assert roc.calculate_auc(0) > 0.9
+    assert 0.4 < roc.calculate_auc(1) < 0.6

@@ -1,0 +1,144 @@
+"""Fused Pallas LSTM kernel vs the scan path (ops/pallas_lstm.py).
+
+Runs the kernel in interpreter mode on the CPU test backend (the real
+lowering is exercised on TPU by bench.py); correctness = forward AND
+gradient equality against the lax.scan reference implementation.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.ops import pallas_lstm
+from deeplearning4j_tpu.ops.helpers import (
+    get_helper,
+    helper_names,
+    set_helper_enabled,
+)
+
+
+@pytest.fixture(autouse=True)
+def _interpret_mode():
+    old = pallas_lstm._INTERPRET
+    pallas_lstm._INTERPRET = True
+    yield
+    pallas_lstm._INTERPRET = old
+
+
+def _scan_reference(xg_t, rw, h0, c0):
+    def step(carry, g_in):
+        h, c = carry
+        g = g_in + h @ rw
+        H = h.shape[-1]
+        i = jax.nn.sigmoid(g[:, :H])
+        f = jax.nn.sigmoid(g[:, H:2 * H])
+        gg = jnp.tanh(g[:, 2 * H:3 * H])
+        o = jax.nn.sigmoid(g[:, 3 * H:])
+        c_new = f * c + i * gg
+        h_new = o * jnp.tanh(c_new)
+        return (h_new, c_new), h_new
+
+    (hF, cF), ys = jax.lax.scan(step, (h0, c0), xg_t)
+    return ys, hF, cF
+
+
+def test_kernel_matches_scan_forward_and_grad():
+    rng = np.random.default_rng(0)
+    T, B, H = 5, 8, 16
+    xg = jnp.asarray(rng.standard_normal((T, B, 4 * H)), jnp.float32)
+    rw = jnp.asarray(rng.standard_normal((H, 4 * H)) * 0.2, jnp.float32)
+    h0 = jnp.asarray(rng.standard_normal((B, H)) * 0.1, jnp.float32)
+    c0 = jnp.asarray(rng.standard_normal((B, H)) * 0.1, jnp.float32)
+
+    y1, hF1, cF1 = pallas_lstm.lstm_sequence(xg, rw, h0, c0)
+    y2, hF2, cF2 = _scan_reference(xg, rw, h0, c0)
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y2),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(cF1), np.asarray(cF2),
+                               rtol=1e-5, atol=1e-5)
+
+    def loss_k(xg, rw, h0, c0):
+        y, hF, cF = pallas_lstm.lstm_sequence(xg, rw, h0, c0)
+        return (jnp.sum(y * y) + jnp.sum(jnp.sin(hF))
+                + jnp.sum(jnp.cos(cF)))
+
+    def loss_s(xg, rw, h0, c0):
+        y, hF, cF = _scan_reference(xg, rw, h0, c0)
+        return (jnp.sum(y * y) + jnp.sum(jnp.sin(hF))
+                + jnp.sum(jnp.cos(cF)))
+
+    g1 = jax.grad(loss_k, argnums=(0, 1, 2, 3))(xg, rw, h0, c0)
+    g2 = jax.grad(loss_s, argnums=(0, 1, 2, 3))(xg, rw, h0, c0)
+    for a, b, name in zip(g1, g2, ("dxg", "drw", "dh0", "dc0")):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-4,
+            err_msg=f"gradient mismatch in {name}")
+
+
+def test_helper_registered_and_probed():
+    assert helper_names().get("lstm_sequence") == "pallas_fused_lstm"
+    # supported in interpret mode with the standard config
+    assert get_helper("lstm_sequence", peephole=False, mask=None,
+                      gate_act="sigmoid", cell_act="tanh",
+                      reverse=False) is not None
+    # fallback cases
+    for ctx in (dict(peephole=True), dict(mask=np.ones((2, 3))),
+                dict(gate_act="hardsigmoid"), dict(cell_act="relu"),
+                dict(reverse=True)):
+        base = dict(peephole=False, mask=None, gate_act="sigmoid",
+                    cell_act="tanh", reverse=False)
+        base.update(ctx)
+        assert get_helper("lstm_sequence", **base) is None, ctx
+    # kill switch
+    set_helper_enabled("lstm_sequence", False)
+    try:
+        assert get_helper("lstm_sequence", peephole=False, mask=None,
+                          gate_act="sigmoid", cell_act="tanh",
+                          reverse=False) is None
+    finally:
+        set_helper_enabled("lstm_sequence", True)
+
+
+def test_network_lstm_uses_helper_and_matches_scan():
+    """End to end: an LSTM net trained one step with the helper enabled
+    equals the scan path (kernel swapped in via the SPI, not by calling
+    it directly)."""
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    from deeplearning4j_tpu.nn.conf.layers import LSTM, RnnOutputLayer
+    from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    def build():
+        conf = (NeuralNetConfiguration.builder().seed(4)
+                .weight_init("xavier").learning_rate(0.1).list()
+                .layer(LSTM(n_out=12, activation="tanh"))
+                .layer(RnnOutputLayer(n_out=3, activation="softmax",
+                                      loss="mcxent"))
+                .set_input_type(InputType.recurrent(6)).build())
+        return MultiLayerNetwork(conf).init()
+
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((4, 7, 6)).astype(np.float32)
+    y = np.zeros((4, 7, 3), np.float32)
+    y[..., 0] = 1.0
+
+    net_helper = build()
+    net_helper.fit(x, y, batch_size=4, epochs=1, async_prefetch=False)
+    out_helper = np.asarray(net_helper.output(x))
+
+    set_helper_enabled("lstm_sequence", False)
+    try:
+        net_scan = build()
+        net_scan.fit(x, y, batch_size=4, epochs=1, async_prefetch=False)
+        out_scan = np.asarray(net_scan.output(x))
+    finally:
+        set_helper_enabled("lstm_sequence", True)
+
+    np.testing.assert_allclose(out_helper, out_scan, rtol=2e-4, atol=2e-5)
+    for p1, p2 in zip(net_helper.params_list, net_scan.params_list):
+        for k in p1:
+            np.testing.assert_allclose(
+                np.asarray(p1[k]), np.asarray(p2[k]), rtol=2e-4, atol=2e-5,
+                err_msg=f"param {k}")

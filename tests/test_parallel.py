@@ -186,15 +186,51 @@ def test_workers_stacking_minibatches():
 
 
 def test_tail_batch_not_divisible():
-    """A tail batch not divisible by the device count still trains
-    (replicated fallback)."""
+    """A tail batch not divisible by the device count trains SHARDED via
+    pad-and-mask (wrapped pad rows, zero labels-mask, masked-example
+    mean), with numerics exactly equal to single-device training on the
+    same examples."""
     x, y = _data(36)  # 36 = 2*16 + tail 4
+    net1 = MultiLayerNetwork(_mlp_conf()).init()
+    net8 = MultiLayerNetwork(_mlp_conf()).init()
+    net1.fit(x, y, batch_size=16, epochs=1, async_prefetch=False)
+    ParallelWrapper(net8, data_parallel_mesh()).fit(
+        x, y, batch_size=16, epochs=1, async_prefetch=False
+    )
+    assert net8.iteration == 3
+    assert np.isfinite(float(net8._score))
+    for p1, p8 in zip(net1.params_list, net8.params_list):
+        for k in p1:
+            np.testing.assert_allclose(
+                np.asarray(p1[k]), np.asarray(p8[k]), rtol=2e-5, atol=2e-6
+            )
+    # every batch (incl. the padded tail) was sharded over all 8 devices
+    w0 = net8.params_list[0]["W"]
+    assert len(w0.sharding.device_set) == 8
+
+
+def test_tail_batch_single_executable():
+    """Pad-and-mask keeps ONE compiled executable across an epoch with a
+    non-divisible tail — no tail-shape recompile (round-2 weakness)."""
+    x, y = _data(36)
     net = MultiLayerNetwork(_mlp_conf()).init()
     ParallelWrapper(net, data_parallel_mesh()).fit(
         x, y, batch_size=16, epochs=1, async_prefetch=False
     )
-    assert net.iteration == 3
+    assert net._train_step_fn._cache_size() == 1
+
+
+def test_tail_smaller_than_device_count():
+    """A tail smaller than the mesh (pad > n) wraps cyclically."""
+    x, y = _data(19)  # tail of 3 on 8 devices
+    net = MultiLayerNetwork(_mlp_conf()).init()
+    wrapper = ParallelWrapper(net, data_parallel_mesh())
+    wrapper.fit(x, y, batch_size=16, epochs=1, async_prefetch=False)
     assert np.isfinite(float(net._score))
+    # padded inference path: result matches plain forward on the unpadded x
+    out = np.asarray(wrapper.output(x[:5]))
+    np.testing.assert_allclose(
+        out, np.asarray(net.output(x[:5])), rtol=2e-5, atol=1e-6)
 
 
 def test_parallel_inference_matches_output():
@@ -230,6 +266,26 @@ def test_parallel_inference_sequential():
     np.testing.assert_allclose(
         np.asarray(pi.output(x)), np.asarray(net.output(x)), rtol=2e-5, atol=1e-6
     )
+
+
+def test_parallel_inference_validates_shapes():
+    """Mismatched trailing dims are rejected at output() — not deep in the
+    collector where they would fail the whole fused group; oversized
+    requests run alone instead of overshooting a fused batch."""
+    x, _ = _data(16)
+    net = MultiLayerNetwork(_mlp_conf()).init()
+    pi = ParallelInference(net, data_parallel_mesh(), max_batch_size=8)
+    try:
+        out = np.asarray(pi.output(x[:8]))
+        assert out.shape == (8, 4)
+        with pytest.raises(ValueError, match="does not match"):
+            pi.output(np.zeros((4, 7), np.float32))
+        # oversized request (16 > max_batch_size 8) still served
+        out = np.asarray(pi.output(x))
+        np.testing.assert_allclose(
+            out, np.asarray(net.output(x)), rtol=2e-5, atol=1e-6)
+    finally:
+        pi.shutdown()
 
 
 def test_dp_tbptt_routes_through_segment_loop():
