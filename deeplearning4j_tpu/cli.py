@@ -105,8 +105,8 @@ def cmd_train(args) -> int:
             data_parallel_mesh,
         )
 
-        ParallelWrapper(net, data_parallel_mesh()).fit(
-            it, epochs=args.epochs)
+        ParallelWrapper(net, data_parallel_mesh(),
+                        workers=args.workers).fit(it, epochs=args.epochs)
     else:
         net.fit(it, epochs=args.epochs)
 

@@ -80,11 +80,14 @@ class CifarDataFetcher:
         if not tar.exists():
             if not self.allow_download:
                 return None
+            tmp = tar.with_suffix(".tmp")
             try:
                 with urllib.request.urlopen(_CIFAR_URL, timeout=30) as r, \
-                        open(tar, "wb") as f:
+                        open(tmp, "wb") as f:
                     f.write(r.read())
+                os.replace(tmp, tar)  # atomic: no truncated cache entries
             except OSError:
+                tmp.unlink(missing_ok=True)
                 return None
         try:
             xs, ys = [], []
@@ -102,7 +105,10 @@ class CifarDataFetcher:
                  .transpose(0, 2, 3, 1) / 255.0).astype(np.float32)
             y = _onehot(np.concatenate(ys), 10)
             return x, y
-        except (OSError, KeyError, pickle.UnpicklingError):
+        except (OSError, KeyError, EOFError, tarfile.TarError,
+                pickle.UnpicklingError):
+            # corrupt cache: drop it so the next run can re-download
+            tar.unlink(missing_ok=True)
             return None
 
     def load(self, train: bool):

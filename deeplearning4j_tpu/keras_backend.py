@@ -12,10 +12,11 @@ from __future__ import annotations
 
 import json
 import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 import numpy as np
+
+from deeplearning4j_tpu.utils.jsonhttp import JsonHttpServer, json_response
 
 from deeplearning4j_tpu.modelimport.keras import (
     import_keras_sequential_config,
@@ -61,10 +62,21 @@ class KerasBackendServer:
     scores it."""
 
     def __init__(self, port: int = 0):
-        self.port = int(port)
-        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._server = JsonHttpServer(post=self._post, port=port)
         self._net: Optional[MultiLayerNetwork] = None
         self._lock = threading.Lock()
+
+    @property
+    def port(self) -> int:
+        return self._server.port
+
+    def _post(self, path, body, headers):
+        req = json.loads(body)
+        if path == "/fit":
+            return json_response(self._fit(req))
+        if path == "/evaluate":
+            return json_response(self._evaluate(req))
+        return None
 
     def _fit(self, body: dict) -> dict:
         x = _load_array(body["features_path"], body.get("features_dataset"))
@@ -96,39 +108,7 @@ class KerasBackendServer:
         return ListDataSetIterator(DataSet(x, y), batch)
 
     def start(self) -> int:
-        outer = self
-
-        class Handler(BaseHTTPRequestHandler):
-            def log_message(self, *a):
-                pass
-
-            def do_POST(self):
-                n = int(self.headers.get("Content-Length", 0))
-                try:
-                    body = json.loads(self.rfile.read(n))
-                    if self.path == "/fit":
-                        payload, code = outer._fit(body), 200
-                    elif self.path == "/evaluate":
-                        payload, code = outer._evaluate(body), 200
-                    else:
-                        payload, code = {"error": "no route"}, 404
-                except Exception as e:  # surface as JSON, keep serving
-                    payload, code = {"error": f"{type(e).__name__}: {e}"}, 400
-                data = json.dumps(payload).encode()
-                self.send_response(code)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(data)))
-                self.end_headers()
-                self.wfile.write(data)
-
-        self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port), Handler)
-        self.port = self._httpd.server_address[1]
-        threading.Thread(target=self._httpd.serve_forever,
-                         daemon=True).start()
-        return self.port
+        return self._server.start()
 
     def stop(self):
-        if self._httpd is not None:
-            self._httpd.shutdown()
-            self._httpd.server_close()
-            self._httpd = None
+        self._server.stop()

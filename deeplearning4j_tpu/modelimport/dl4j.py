@@ -70,14 +70,13 @@ def write_nd4j_array(arr: np.ndarray, stream) -> None:
     stream.write(struct.pack(">i", len(info)))
     stream.write(struct.pack(f">{len(info)}i", *info))
     if arr.dtype == np.float64:
-        tag, fmt = "DOUBLE", "d"
+        tag, be = "DOUBLE", ">f8"
     else:
         arr = arr.astype(np.float32)
-        tag, fmt = "FLOAT", "f"
+        tag, be = "FLOAT", ">f4"
     tag_b = tag.encode()
     stream.write(struct.pack(">H", len(tag_b)) + tag_b)  # writeUTF
-    flat = arr.reshape(-1)
-    stream.write(struct.pack(f">{flat.size}{fmt}", *flat.tolist()))
+    stream.write(np.ascontiguousarray(arr.reshape(-1)).astype(be).tobytes())
 
 
 def read_nd4j_array(stream) -> np.ndarray:
@@ -91,10 +90,11 @@ def read_nd4j_array(stream) -> np.ndarray:
     tag = stream.read(tag_len).decode()
     if tag not in _DTYPES:
         raise ValueError(f"unsupported nd4j dtype tag {tag!r}")
-    fmt, width = _DTYPES[tag]
+    _, width = _DTYPES[tag]
     count = int(np.prod(shape)) if shape else 0
-    data = struct.unpack(f">{count}{fmt}", stream.read(width * count))
-    a = np.array(data, np.float32 if tag == "FLOAT" else np.float64)
+    be = ">f4" if tag == "FLOAT" else ">f8"
+    a = np.frombuffer(stream.read(width * count), dtype=be).astype(
+        np.float32 if tag == "FLOAT" else np.float64)
     return a.reshape(shape, order="f" if order == "f" else "c")
 
 

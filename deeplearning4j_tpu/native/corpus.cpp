@@ -1,0 +1,164 @@
+// Native corpus pipeline — tokenization + vocab construction + indexing.
+//
+// The runtime-side analog of the reference's text pipeline
+// (text/tokenization/ + VocabConstructor.java, 612 LoC, which fans out
+// Java worker threads because per-token JVM work was the bottleneck).
+// Here the whole pass — read, tokenize, hash-count, frequency-sort,
+// re-index — runs in C++ behind a ctypes boundary; Python sees only
+// numpy arrays. A pure-Python dict/Counter pass over a multi-GB corpus
+// is 10-30x slower and holds the GIL the whole time.
+//
+// Contract (must match nlp/vocab.VocabConstructor): vocabulary sorted by
+// (count desc, word asc); tokens split on ASCII whitespace; optional
+// lowercasing.
+//
+// Build: g++ -O3 -shared -fPIC -std=c++17 corpus.cpp -o libdl4jcorpus.so
+// (native/__init__.py does this on first use and caches the .so).
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <fstream>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Corpus {
+    // token stream as indices into `words` (pre-filter ids)
+    std::vector<int64_t> stream;
+    std::vector<int64_t> sentence_offsets;  // start of each sentence
+    std::vector<std::string> words;         // first-seen order
+    std::vector<int64_t> counts;            // aligned with words
+
+    // filtered+sorted view (built per min_count)
+    int64_t cached_min_count = -1;
+    std::vector<int64_t> rank;      // pre-filter id -> vocab index or -1
+    std::vector<int64_t> vocab_ids; // vocab index -> pre-filter id
+};
+
+inline bool is_space(char c) {
+    return c == ' ' || c == '\t' || c == '\r' || c == '\n' || c == '\v'
+        || c == '\f';
+}
+
+void build_ranks(Corpus* c, int64_t min_count) {
+    if (c->cached_min_count == min_count) return;
+    std::vector<int64_t> keep;
+    keep.reserve(c->words.size());
+    for (int64_t i = 0; i < (int64_t)c->words.size(); ++i)
+        if (c->counts[i] >= min_count) keep.push_back(i);
+    // (count desc, word asc) — the VocabConstructor ordering
+    std::sort(keep.begin(), keep.end(), [&](int64_t a, int64_t b) {
+        if (c->counts[a] != c->counts[b]) return c->counts[a] > c->counts[b];
+        return c->words[a] < c->words[b];
+    });
+    c->rank.assign(c->words.size(), -1);
+    for (int64_t r = 0; r < (int64_t)keep.size(); ++r)
+        c->rank[keep[r]] = r;
+    c->vocab_ids = std::move(keep);
+    c->cached_min_count = min_count;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Tokenize + count a whole file. Returns an opaque handle (nullptr on
+// I/O failure). newline = sentence boundary.
+void* corpus_open(const char* path, int lowercase) {
+    std::ifstream f(path, std::ios::binary);
+    if (!f) return nullptr;
+    auto* c = new Corpus();
+    std::unordered_map<std::string, int64_t> ids;
+    std::string line, tok;
+    while (std::getline(f, line)) {
+        c->sentence_offsets.push_back((int64_t)c->stream.size());
+        size_t i = 0, n = line.size();
+        while (i < n) {
+            while (i < n && is_space(line[i])) ++i;
+            size_t j = i;
+            while (j < n && !is_space(line[j])) ++j;
+            if (j > i) {
+                tok.assign(line, i, j - i);
+                if (lowercase)
+                    for (auto& ch : tok)
+                        if (ch >= 'A' && ch <= 'Z') ch += 32;
+                auto it = ids.find(tok);
+                int64_t id;
+                if (it == ids.end()) {
+                    id = (int64_t)c->words.size();
+                    ids.emplace(tok, id);
+                    c->words.push_back(tok);
+                    c->counts.push_back(0);
+                } else {
+                    id = it->second;
+                }
+                ++c->counts[id];
+                c->stream.push_back(id);
+            }
+            i = j;
+        }
+    }
+    c->sentence_offsets.push_back((int64_t)c->stream.size());
+    return c;
+}
+
+void corpus_close(void* h) { delete static_cast<Corpus*>(h); }
+
+int64_t corpus_total_tokens(void* h) {
+    return (int64_t)static_cast<Corpus*>(h)->stream.size();
+}
+
+int64_t corpus_num_sentences(void* h) {
+    return (int64_t)static_cast<Corpus*>(h)->sentence_offsets.size() - 1;
+}
+
+int64_t corpus_vocab_size(void* h, int64_t min_count) {
+    auto* c = static_cast<Corpus*>(h);
+    build_ranks(c, min_count);
+    return (int64_t)c->vocab_ids.size();
+}
+
+// Byte length of the '\n'-joined vocab dump (for buffer sizing).
+int64_t corpus_vocab_bytes(void* h, int64_t min_count) {
+    auto* c = static_cast<Corpus*>(h);
+    build_ranks(c, min_count);
+    int64_t total = 0;
+    for (int64_t id : c->vocab_ids) total += (int64_t)c->words[id].size() + 1;
+    return total;
+}
+
+// Write words ('\n'-joined, vocab order) into buf and counts into
+// counts_out [vocab_size]. Returns bytes written, or -1 if buf too small.
+int64_t corpus_vocab_dump(void* h, int64_t min_count, char* buf,
+                          int64_t buf_len, int64_t* counts_out) {
+    auto* c = static_cast<Corpus*>(h);
+    build_ranks(c, min_count);
+    int64_t off = 0;
+    for (int64_t r = 0; r < (int64_t)c->vocab_ids.size(); ++r) {
+        const std::string& w = c->words[c->vocab_ids[r]];
+        if (off + (int64_t)w.size() + 1 > buf_len) return -1;
+        std::memcpy(buf + off, w.data(), w.size());
+        off += (int64_t)w.size();
+        buf[off++] = '\n';
+        counts_out[r] = c->counts[c->vocab_ids[r]];
+    }
+    return off;
+}
+
+// Re-index the token stream against the (min_count-filtered) vocab:
+// tokens_out [total_tokens] gets the vocab index or -1 (filtered word);
+// offsets_out [num_sentences + 1] gets sentence start offsets.
+void corpus_index(void* h, int64_t min_count, int32_t* tokens_out,
+                  int64_t* offsets_out) {
+    auto* c = static_cast<Corpus*>(h);
+    build_ranks(c, min_count);
+    for (size_t i = 0; i < c->stream.size(); ++i)
+        tokens_out[i] = (int32_t)c->rank[c->stream[i]];
+    for (size_t i = 0; i < c->sentence_offsets.size(); ++i)
+        offsets_out[i] = c->sentence_offsets[i];
+}
+
+}  // extern "C"

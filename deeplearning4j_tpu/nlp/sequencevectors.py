@@ -107,6 +107,34 @@ class SequenceVectors:
             out.append(np.asarray(idx, np.int64))
         return out
 
+    def fit_file(self, path: str, lowercase: bool = False):
+        """Train straight from a text file (newline = sentence) through
+        the NATIVE corpus pipeline (native/corpus.cpp — the C++
+        VocabConstructor/text-pipeline analog): tokenize, count, sort and
+        index entirely outside Python, then stream the indexed sentences
+        into the device step. Falls back to the Python tokenizer/vocab
+        when no C++ toolchain is available."""
+        from deeplearning4j_tpu import native as native_mod
+
+        if not native_mod.native_available():
+            logger.warning("native corpus pipeline unavailable; "
+                           "falling back to Python tokenization")
+            with open(path) as f:
+                seqs = [line.split() for line in f]
+            if lowercase:
+                seqs = [[t.lower() for t in s] for s in seqs]
+            return self.fit(seqs)
+        with native_mod.NativeCorpus(path, lowercase=lowercase) as corpus:
+            words, counts = corpus.vocab(self.conf.min_word_frequency)
+            vocab = VocabCache()
+            for w, c in zip(words, counts):
+                vocab.add(w, int(c))
+            self.vocab = vocab
+            self.build_vocab()  # huffman + lookup over the native vocab
+            indexed = corpus.indexed_sentences(self.conf.min_word_frequency)
+        self.train_indexed(indexed)
+        return self
+
     def fit(self, sequences: Optional[Iterable[Sequence[str]]] = None):
         """Build vocab (if needed) and train (reference:
         SequenceVectors.fit :187)."""

@@ -62,11 +62,15 @@ def _as_multidataset(ds) -> MultiDataSet:
     if isinstance(ds, MultiDataSet):
         return ds
     if isinstance(ds, DataSet):
-        return MultiDataSet(
+        out = MultiDataSet(
             [ds.features], [ds.labels],
             None if ds.features_mask is None else [ds.features_mask],
             None if ds.labels_mask is None else [ds.labels_mask],
         )
+        # keep the wrapper's real-example count for listener accounting
+        if hasattr(ds, "reported_examples"):
+            out.reported_examples = ds.reported_examples
+        return out
     raise TypeError(f"expected DataSet or MultiDataSet, got {type(ds)}")
 
 
@@ -396,21 +400,20 @@ class ComputationGraph(NetworkBase):
             if _is_recurrent(lc) and states[i] is None:
                 states[i] = {}
 
+        def cut_mask(m, sl):
+            if m is None:
+                return None
+            return m if m.ndim == 1 else m[:, sl]  # 1-D = per-example mask
+
         def cut(sl):
             feats = [f[:, sl] if f.ndim == 3 else f for f in mds.features]
             labels = [y[:, sl] if y.ndim == 3 else y for y in mds.labels]
             fms = None
             if mds.features_masks is not None:
-                fms = [
-                    None if m is None else m[:, sl]
-                    for m in mds.features_masks
-                ]
+                fms = [cut_mask(m, sl) for m in mds.features_masks]
             lms = None
             if mds.labels_masks is not None:
-                lms = [
-                    None if m is None else m[:, sl]
-                    for m in mds.labels_masks
-                ]
+                lms = [cut_mask(m, sl) for m in mds.labels_masks]
             return (feats, labels, fms, lms)
 
         for start in range(0, T, seg):

@@ -366,6 +366,25 @@ class LSTM(BaseRecurrentLayerConf):
     gate_activation: str = "sigmoid"
 
 
+@register_config("layer.self_attention")
+@dataclasses.dataclass(kw_only=True)
+class SelfAttentionLayer(BaseRecurrentLayerConf):
+    """Multi-head self-attention over the time axis — capability BEYOND
+    the reference (DL4J 0.8 predates attention; SURVEY §5 lists
+    long-context as greenfield). [b, t, nIn] -> [b, t, nOut]; nOut must
+    divide n_heads. ``causal`` masks future positions. The
+    sequence-parallel execution of the same math is
+    parallel/sequence.ring_self_attention."""
+
+    n_heads: int = 4
+    causal: bool = False
+    projection_bias: bool = True
+
+    def output_type(self, it):
+        ts = it.timesteps if isinstance(it, RecurrentInput) else None
+        return RecurrentInput(self.n_out, ts)
+
+
 @register_config("layer.graves_lstm")
 @dataclasses.dataclass(kw_only=True)
 class GravesLSTM(BaseRecurrentLayerConf):

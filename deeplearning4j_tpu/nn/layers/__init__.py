@@ -19,4 +19,4 @@ from deeplearning4j_tpu.nn.layers.registry import (
 )
 
 # Import impl modules for their registration side effects.
-from deeplearning4j_tpu.nn.layers import core, conv, norm, rbm, recurrent, special  # noqa: E402,F401
+from deeplearning4j_tpu.nn.layers import attention, core, conv, norm, rbm, recurrent, special  # noqa: E402,F401

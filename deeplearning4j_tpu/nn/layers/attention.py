@@ -1,0 +1,86 @@
+"""Multi-head self-attention layer impl (config: SelfAttentionLayer).
+
+Single-device forward uses parallel/sequence.full_attention; the SAME math
+runs sequence-parallel over a mesh via ring_self_attention (parallel/
+sequence.py) — tests prove block-ring == full. Time masking multiplies
+attention scores' keys (masked keys unattendable) and zeroes masked
+outputs, matching the framework's RNN masking semantics.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.nn.layers.registry import LayerContext, register_layer
+from deeplearning4j_tpu.nn.weights import init_weights
+from deeplearning4j_tpu.ops.activations import apply_activation
+from deeplearning4j_tpu.parallel.sequence import full_attention
+
+
+def attention_init(key, conf: L.SelfAttentionLayer, dtype):
+    n_in, n_out = int(conf.n_in), int(conf.n_out)
+    if n_out % conf.n_heads != 0:
+        raise ValueError(
+            f"n_out {n_out} must divide n_heads {conf.n_heads}")
+    ks = jax.random.split(key, 4)
+    mk = lambda k, i, o: init_weights(k, (i, o), i, o, conf.weight_init,
+                                      conf.dist, dtype)
+    p = {
+        "Wq": mk(ks[0], n_in, n_out),
+        "Wk": mk(ks[1], n_in, n_out),
+        "Wv": mk(ks[2], n_in, n_out),
+        "Wo": mk(ks[3], n_out, n_out),
+    }
+    if conf.projection_bias:
+        p["b"] = jnp.zeros((n_out,), dtype)
+    return p
+
+
+def attention_forward(conf: L.SelfAttentionLayer, params, x,
+                      ctx: LayerContext):
+    """x: [b, t, nIn] -> [b, t, nOut]."""
+    B, T, _ = x.shape
+    H = int(conf.n_heads)
+    E = int(conf.n_out)
+    D = E // H
+    dt = x.dtype
+    q = (x @ params["Wq"].astype(dt)).reshape(B, T, H, D)
+    k = (x @ params["Wk"].astype(dt)).reshape(B, T, H, D)
+    v = (x @ params["Wv"].astype(dt)).reshape(B, T, H, D)
+    if ctx.mask is not None:
+        # masked keys contribute nothing: push their scores to -inf by
+        # zeroing v and biasing k is fragile — mask scores directly
+        o = _masked_attention(q, k, v, ctx.mask.astype(dt), conf.causal)
+    else:
+        o = full_attention(q, k, v, causal=conf.causal)
+    y = o.reshape(B, T, E) @ params["Wo"].astype(dt)
+    if conf.projection_bias:
+        y = y + params["b"].astype(dt)
+    if ctx.mask is not None:
+        y = y * ctx.mask.astype(dt)[..., None]
+    return apply_activation(conf.activation or "identity", y,
+                            key=ctx.rng, training=ctx.training), None
+
+
+def _masked_attention(q, k, v, mask, causal):
+    scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    neg = jnp.asarray(-1e30, s.dtype)
+    s = jnp.where(mask[:, None, None, :] > 0, s, neg)
+    if causal:
+        T = q.shape[1]
+        tri = jnp.tril(jnp.ones((T, T), bool))
+        s = jnp.where(tri, s, neg)
+    a = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", a, v)
+
+
+def attention_order(conf):
+    return ("Wq", "Wk", "Wv", "Wo", "b") if conf.projection_bias else (
+        "Wq", "Wk", "Wv", "Wo")
+
+
+register_layer(L.SelfAttentionLayer, attention_init, attention_forward,
+               order_fn=attention_order)

@@ -37,10 +37,11 @@ import json
 import queue
 import threading
 import urllib.request
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional
 
 import numpy as np
+
+from deeplearning4j_tpu.utils.jsonhttp import JsonHttpServer, json_response
 
 
 class EmbeddingParameterServer:
@@ -49,9 +50,12 @@ class EmbeddingParameterServer:
     def __init__(self, tables: Dict[str, np.ndarray], port: int = 0):
         self.tables = {k: np.asarray(v, np.float32) for k, v in tables.items()}
         self._locks = {k: threading.Lock() for k in self.tables}
-        self.port = int(port)
-        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._server = JsonHttpServer(post=self._post, port=port)
         self.pushes_applied = 0
+
+    @property
+    def port(self) -> int:
+        return self._server.port
 
     # -- core ops ------------------------------------------------------------
 
@@ -67,51 +71,22 @@ class EmbeddingParameterServer:
 
     # -- http transport ------------------------------------------------------
 
+    def _post(self, path, body, headers):
+        req = json.loads(body)
+        name = req["table"]
+        rows = req["rows"]
+        if path == "/pull":
+            return json_response({"data": self.pull(name, rows).tolist()})
+        if path == "/push":
+            self.push(name, rows, np.asarray(req["deltas"], np.float32))
+            return json_response({"status": "ok"})
+        return None
+
     def start(self) -> int:
-        outer = self
-
-        class Handler(BaseHTTPRequestHandler):
-            def log_message(self, *a):
-                pass
-
-            def do_POST(self):
-                n = int(self.headers.get("Content-Length", 0))
-                try:
-                    body = json.loads(self.rfile.read(n))
-                    name = body["table"]
-                    rows = body["rows"]
-                    if self.path == "/pull":
-                        out = outer.pull(name, rows)
-                        payload = json.dumps(
-                            {"data": out.tolist()}).encode()
-                    elif self.path == "/push":
-                        outer.push(name, rows,
-                                   np.asarray(body["deltas"], np.float32))
-                        payload = b'{"status":"ok"}'
-                    else:
-                        self.send_response(404)
-                        self.end_headers()
-                        return
-                    self.send_response(200)
-                except (KeyError, ValueError, IndexError) as e:
-                    payload = json.dumps({"error": str(e)}).encode()
-                    self.send_response(400)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(payload)))
-                self.end_headers()
-                self.wfile.write(payload)
-
-        self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port), Handler)
-        self.port = self._httpd.server_address[1]
-        threading.Thread(target=self._httpd.serve_forever,
-                         daemon=True).start()
-        return self.port
+        return self._server.start()
 
     def stop(self):
-        if self._httpd is not None:
-            self._httpd.shutdown()
-            self._httpd.server_close()
-            self._httpd = None
+        self._server.stop()
 
 
 class EmbeddingPSClient:

@@ -16,13 +16,11 @@ from __future__ import annotations
 
 import argparse
 import json
-import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional
 
 import numpy as np
 
 from deeplearning4j_tpu.clustering.vptree import VPTree
+from deeplearning4j_tpu.utils.jsonhttp import JsonHttpServer, json_response
 
 
 class NearestNeighborsServer:
@@ -31,83 +29,57 @@ class NearestNeighborsServer:
                  invert: bool = False, port: int = 9000):
         self.points = np.asarray(points, np.float32)
         self.tree = VPTree(self.points, similarity_function, invert)
-        self.port = int(port)
-        self._httpd: Optional[ThreadingHTTPServer] = None
-        self._thread: Optional[threading.Thread] = None
+        self._server = JsonHttpServer(get=self._get, post=self._post,
+                                      port=port)
+
+    @property
+    def port(self) -> int:
+        return self._server.port
 
     # -- request handling ---------------------------------------------------
 
-    def _handle(self, route: str, body: dict) -> tuple:
-        if route == "/knn":
-            k = int(body["k"])
-            idx = int(body["inputIndex"])
+    def _get(self, path, body, headers):
+        if path == "/health":
+            return json_response({"status": "ok",
+                                  "points": self.points.shape[0]})
+        return None
+
+    def _post(self, path, body, headers):
+        req = json.loads(body or b"{}")
+        if path == "/knn":
+            k = int(req["k"])
+            idx = int(req["inputIndex"])
             if not (0 <= idx < self.points.shape[0]):
-                return 400, {"error": f"inputIndex {idx} out of range"}
+                return json_response(
+                    {"error": f"inputIndex {idx} out of range"}, 400)
             target = self.points[idx]
-        elif route == "/knnvector":
-            k = int(body["k"])
-            target = np.asarray(body["vector"], np.float32)
+        elif path == "/knnvector":
+            k = int(req["k"])
+            target = np.asarray(req["vector"], np.float32)
             if target.shape != (self.points.shape[1],):
-                return 400, {
-                    "error": f"vector must have dim {self.points.shape[1]}"
-                }
+                return json_response(
+                    {"error":
+                     f"vector must have dim {self.points.shape[1]}"}, 400)
         else:
-            return 404, {"error": f"no route {route}"}
+            return None
         indices, distances = self.tree.search(target, k)
-        return 200, {
+        return json_response({
             "results": [
                 {"index": int(i), "distance": float(d)}
                 for i, d in zip(indices, distances)
             ]
-        }
+        })
 
     # -- lifecycle ----------------------------------------------------------
 
     def start(self) -> int:
-        """Start serving on a background thread; returns the bound port
-        (useful with port=0 for tests)."""
-        outer = self
-
-        class Handler(BaseHTTPRequestHandler):
-            def log_message(self, *a):
-                pass
-
-            def _send(self, code: int, payload: dict):
-                data = json.dumps(payload).encode()
-                self.send_response(code)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(data)))
-                self.end_headers()
-                self.wfile.write(data)
-
-            def do_GET(self):
-                if self.path == "/health":
-                    self._send(200, {"status": "ok",
-                                     "points": outer.points.shape[0]})
-                else:
-                    self._send(404, {"error": "not found"})
-
-            def do_POST(self):
-                try:
-                    n = int(self.headers.get("Content-Length", 0))
-                    body = json.loads(self.rfile.read(n) or b"{}")
-                    code, payload = outer._handle(self.path, body)
-                except (ValueError, KeyError, TypeError) as e:
-                    code, payload = 400, {"error": str(e)}
-                self._send(code, payload)
-
-        self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port), Handler)
-        self.port = self._httpd.server_address[1]
-        self._thread = threading.Thread(target=self._httpd.serve_forever,
-                                        daemon=True)
-        self._thread.start()
-        return self.port
+        return self._server.start()
 
     def stop(self):
-        if self._httpd is not None:
-            self._httpd.shutdown()
-            self._httpd.server_close()
-            self._httpd = None
+        self._server.stop()
+
+    def join(self):
+        self._server.join()
 
 
 def main(argv=None):
@@ -126,7 +98,7 @@ def main(argv=None):
     port = server.start()
     print(f"nearest-neighbors server listening on :{port}")
     try:
-        server._thread.join()
+        server.join()
     except KeyboardInterrupt:
         server.stop()
 

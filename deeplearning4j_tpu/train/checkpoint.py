@@ -1,0 +1,158 @@
+"""Preemption-aware checkpointing.
+
+Reference baseline: ModelSerializer zips + early-stopping savers, all
+manual — SURVEY §5 calls elastic/preemption handling "absent...
+greenfield for the TPU build". TPU-idiomatic answer: periodic
+checkpointing as a LISTENER on the existing SPI plus a preemption signal
+hook, because TPU pools reclaim VMs with a SIGTERM grace window; a run
+that saves on SIGTERM and resumes from the newest checkpoint loses at
+most one save interval.
+
+    listener = CheckpointListener("ckpts/", every_n_iterations=500,
+                                  keep_last=3, save_on_preemption=True)
+    net.set_listeners(listener)
+    ...
+    net2, meta = CheckpointListener.restore_latest("ckpts/")
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import signal
+import threading
+import time
+from typing import Optional, Tuple
+
+from deeplearning4j_tpu.train.listeners import IterationListener
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+
+class CheckpointListener(IterationListener):
+    """Periodic + preemption-triggered model saves with retention.
+
+    every_n_iterations / every_n_epochs / every_n_seconds: any
+    combination; a save fires when any schedule is due.
+    keep_last: retain the newest N checkpoints (0 = keep all).
+    save_on_preemption: install a SIGTERM handler that saves
+    synchronously before re-raising the default handler (the TPU/GCE
+    preemption contract)."""
+
+    def __init__(self, directory: str, *,
+                 every_n_iterations: Optional[int] = None,
+                 every_n_epochs: Optional[int] = 1,
+                 every_n_seconds: Optional[float] = None,
+                 keep_last: int = 3,
+                 save_updater: bool = True,
+                 save_on_preemption: bool = False):
+        self.dir = directory
+        os.makedirs(directory, exist_ok=True)
+        self.every_iter = every_n_iterations
+        self.every_epoch = every_n_epochs
+        self.every_seconds = every_n_seconds
+        self.keep_last = int(keep_last)
+        self.save_updater = save_updater
+        self._last_time = time.monotonic()
+        self._model = None
+        self._lock = threading.Lock()
+        self._prev_sigterm = None
+        if save_on_preemption:
+            self._install_preemption_hook()
+
+    # -- listener hooks -------------------------------------------------------
+
+    def iteration_done(self, model, iteration, info):
+        self._model = model
+        due = (self.every_iter is not None and iteration > 0
+               and iteration % self.every_iter == 0)
+        if (not due and self.every_seconds is not None
+                and time.monotonic() - self._last_time >= self.every_seconds):
+            due = True
+        if due:
+            self.save(model, reason="schedule")
+
+    def on_epoch_end(self, model, epoch):
+        self._model = model
+        if self.every_epoch is not None and (epoch + 1) % self.every_epoch == 0:
+            self.save(model, reason="epoch")
+
+    # -- saving ---------------------------------------------------------------
+
+    def save(self, model, reason: str = "manual") -> str:
+        from deeplearning4j_tpu.utils.model_serializer import save_model
+
+        with self._lock:
+            name = f"checkpoint_iter{model.iteration:09d}.zip"
+            path = os.path.join(self.dir, name)
+            tmp = path + ".tmp"
+            save_model(model, tmp, save_updater=self.save_updater)
+            os.replace(tmp, path)  # atomic: never a torn checkpoint
+            meta = {
+                "iteration": int(model.iteration),
+                "epoch": int(model.epoch),
+                "ts": time.time(),
+                "reason": reason,
+                "file": name,
+            }
+            with open(os.path.join(self.dir, "latest.json"), "w") as f:
+                json.dump(meta, f)
+            self._last_time = time.monotonic()
+            self._gc()
+            logger.info("checkpoint saved: %s (%s)", path, reason)
+            return path
+
+    def _gc(self):
+        if self.keep_last <= 0:
+            return
+        ckpts = sorted(
+            f for f in os.listdir(self.dir)
+            if f.startswith("checkpoint_iter") and f.endswith(".zip"))
+        for stale in ckpts[:-self.keep_last]:
+            try:
+                os.remove(os.path.join(self.dir, stale))
+            except OSError:
+                pass
+
+    # -- preemption -----------------------------------------------------------
+
+    def _install_preemption_hook(self):
+        if threading.current_thread() is not threading.main_thread():
+            logger.warning("preemption hook requires the main thread; "
+                           "skipping signal installation")
+            return
+
+        def handler(signum, frame):
+            model = self._model
+            if model is not None:
+                try:
+                    self.save(model, reason="preemption")
+                except Exception:
+                    logger.exception("preemption save failed")
+            if callable(self._prev_sigterm):
+                self._prev_sigterm(signum, frame)
+            else:
+                signal.signal(signal.SIGTERM, signal.SIG_DFL)
+                os.kill(os.getpid(), signal.SIGTERM)
+
+        self._prev_sigterm = signal.getsignal(signal.SIGTERM)
+        signal.signal(signal.SIGTERM, handler)
+
+    # -- resume ---------------------------------------------------------------
+
+    @staticmethod
+    def restore_latest(directory: str,
+                       load_updater: bool = True) -> Tuple[object, dict]:
+        """(model, meta) from the newest checkpoint in `directory`.
+        Raises FileNotFoundError when none exists (fresh start)."""
+        from deeplearning4j_tpu.utils.model_serializer import load_model
+
+        meta_path = os.path.join(directory, "latest.json")
+        if not os.path.exists(meta_path):
+            raise FileNotFoundError(f"no checkpoint in {directory!r}")
+        with open(meta_path) as f:
+            meta = json.load(f)
+        model = load_model(os.path.join(directory, meta["file"]),
+                           load_updater=load_updater)
+        return model, meta

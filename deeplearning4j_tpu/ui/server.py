@@ -15,13 +15,16 @@ from __future__ import annotations
 
 import json
 import struct
-import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 from urllib.parse import urlparse
 
 from deeplearning4j_tpu.ui.codec import decode_record
 from deeplearning4j_tpu.ui.storage import StatsStorage
+from deeplearning4j_tpu.utils.jsonhttp import (
+    JsonHttpServer,
+    html_response,
+    json_response,
+)
 
 
 _PAGE = """<!doctype html>
@@ -96,9 +99,12 @@ class UIServer:
 
     def __init__(self, storage: StatsStorage, port: int = 9090):
         self.storage = storage
-        self.port = int(port)
-        self._httpd: Optional[ThreadingHTTPServer] = None
-        self._thread: Optional[threading.Thread] = None
+        self._server = JsonHttpServer(get=self._get, post=self._post,
+                                      port=port)
+
+    @property
+    def port(self) -> int:
+        return self._server.port
 
     @classmethod
     def get_instance(cls, storage: Optional[StatsStorage] = None,
@@ -186,84 +192,50 @@ class UIServer:
 
     # -- http ----------------------------------------------------------------
 
+    def _get(self, path, body, headers):
+        path = urlparse(path).path.rstrip("/") or "/train/overview"
+        session = self._current_session()
+        pages = {"/train": "overview", "/train/overview": "overview",
+                 "/train/model": "model", "/train/system": "system"}
+        if path in pages:
+            view = pages[path]
+            return html_response(_PAGE.format(title=view, view=view))
+        if path == "/train/overview/data":
+            return json_response(self._overview_data(session))
+        if path == "/train/model/data":
+            return json_response(self._model_data(session))
+        if path == "/train/model/graph":
+            st = (self.storage.get_static_info(session) or {}
+                  ) if session else {}
+            return json_response({"layers": st.get("layers", [])})
+        if path == "/train/system/data":
+            return json_response(self._system_data(session))
+        if path == "/train/sessions/current":
+            return json_response({"session": session})
+        if path == "/train/sessions/all":
+            return json_response(
+                {"sessions": self.storage.list_session_ids()})
+        return None
+
+    def _post(self, path, body, headers):
+        # remote receiver (reference: RemoteReceiverModule)
+        session = headers.get("X-Session-Id", "remote")
+        path = urlparse(path).path
+        try:
+            if path == "/remote/static":
+                self.storage.put_static_info(session, json.loads(body))
+            elif path == "/remote/update":
+                self.storage.put_update(session, decode_record(body))
+            else:
+                return None
+            return json_response({"status": "ok"})
+        except (ValueError, KeyError, IndexError, struct.error) as e:
+            return json_response({"error": str(e)}, 400)
+
     def start(self) -> int:
-        outer = self
-
-        class Handler(BaseHTTPRequestHandler):
-            def log_message(self, *a):
-                pass
-
-            def _send(self, code, body: bytes, ctype="application/json"):
-                self.send_response(code)
-                self.send_header("Content-Type", ctype)
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
-
-            def _json(self, obj, code=200):
-                self._send(code, json.dumps(obj).encode())
-
-            def do_GET(self):
-                path = urlparse(self.path).path.rstrip("/") or "/train/overview"
-                session = outer._current_session()
-                if path in ("/train", "/train/overview"):
-                    self._send(200, _PAGE.format(
-                        title="overview", view="overview").encode(),
-                        "text/html")
-                elif path == "/train/model":
-                    self._send(200, _PAGE.format(
-                        title="model", view="model").encode(), "text/html")
-                elif path == "/train/system":
-                    self._send(200, _PAGE.format(
-                        title="system", view="system").encode(), "text/html")
-                elif path == "/train/overview/data":
-                    self._json(outer._overview_data(session))
-                elif path == "/train/model/data":
-                    self._json(outer._model_data(session))
-                elif path == "/train/model/graph":
-                    st = (outer.storage.get_static_info(session) or {}
-                          ) if session else {}
-                    self._json({"layers": st.get("layers", [])})
-                elif path == "/train/system/data":
-                    self._json(outer._system_data(session))
-                elif path == "/train/sessions/current":
-                    self._json({"session": session})
-                elif path == "/train/sessions/all":
-                    self._json({"sessions": outer.storage.list_session_ids()})
-                else:
-                    self._json({"error": f"no route {path}"}, 404)
-
-            def do_POST(self):
-                # remote receiver (reference: RemoteReceiverModule)
-                n = int(self.headers.get("Content-Length", 0))
-                body = self.rfile.read(n)
-                session = self.headers.get("X-Session-Id", "remote")
-                path = urlparse(self.path).path
-                try:
-                    if path == "/remote/static":
-                        outer.storage.put_static_info(
-                            session, json.loads(body))
-                    elif path == "/remote/update":
-                        outer.storage.put_update(
-                            session, decode_record(body))
-                    else:
-                        return self._json({"error": "bad route"}, 404)
-                    self._json({"status": "ok"})
-                except (ValueError, KeyError, IndexError,
-                        struct.error) as e:
-                    self._json({"error": str(e)}, 400)
-
-        self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port), Handler)
-        self.port = self._httpd.server_address[1]
-        self._thread = threading.Thread(
-            target=self._httpd.serve_forever, daemon=True)
-        self._thread.start()
-        return self.port
+        return self._server.start()
 
     def stop(self):
-        if self._httpd is not None:
-            self._httpd.shutdown()
-            self._httpd.server_close()
-            self._httpd = None
+        self._server.stop()
         if UIServer._instance is self:
             UIServer._instance = None

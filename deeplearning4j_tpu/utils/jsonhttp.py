@@ -1,0 +1,87 @@
+"""Tiny shared HTTP scaffolding for the framework's servers (k-NN
+serving, training UI, embedding parameter server, Keras-backend entry
+point). One place for the Content-Length / parse / respond / error
+boilerplate the four servers would otherwise each re-implement."""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional, Tuple
+
+# handler contract: fn(path, body_bytes, headers) ->
+#   (status, content_type, payload_bytes) or None for "no such route"
+Handler = Callable[[str, bytes, dict], Optional[Tuple[int, str, bytes]]]
+
+
+def json_response(obj, code: int = 200) -> Tuple[int, str, bytes]:
+    return code, "application/json", json.dumps(obj).encode()
+
+
+def html_response(text: str, code: int = 200) -> Tuple[int, str, bytes]:
+    return code, "text/html", text.encode()
+
+
+class JsonHttpServer:
+    """Threaded HTTP server with pluggable GET/POST handlers.
+
+    Handlers may raise: the error is returned as a 400 JSON body and the
+    server keeps serving (a malformed request must never kill a
+    dashboard/serving process)."""
+
+    def __init__(self, *, get: Optional[Handler] = None,
+                 post: Optional[Handler] = None, port: int = 0):
+        self._get = get
+        self._post = post
+        self.port = int(port)
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> int:
+        outer = self
+
+        class _H(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _dispatch(self, handler: Optional[Handler]):
+                n = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(n) if n else b""
+                try:
+                    out = handler(self.path, body, dict(self.headers)) \
+                        if handler else None
+                    if out is None:
+                        out = json_response({"error": "not found"}, 404)
+                except Exception as e:  # keep serving
+                    out = json_response(
+                        {"error": f"{type(e).__name__}: {e}"}, 400)
+                code, ctype, payload = out
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def do_GET(self):
+                self._dispatch(outer._get)
+
+            def do_POST(self):
+                self._dispatch(outer._post)
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port), _H)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self.port
+
+    def stop(self):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+
+    def join(self):
+        if self._thread is not None:
+            self._thread.join()

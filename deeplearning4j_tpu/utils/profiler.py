@@ -1,0 +1,105 @@
+"""Profiling hooks: XLA trace capture + op-level summary.
+
+Reference tier (SURVEY §5 tracing): listener-based throughput counters
+only; deep profiling lived in external ND4J OpProfiler. TPU-native
+answer: jax.profiler traces, captured either around a code block
+(trace()) or per-N-iterations as a listener (ProfilerListener), plus a
+parser that aggregates the captured xplane into per-op device time — the
+exact workflow used to find this framework's BN backward regression
+(f32 cotangent traffic), automated.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import glob
+import logging
+import os
+from collections import Counter
+from typing import Dict, List, Optional, Tuple
+
+import jax
+
+from deeplearning4j_tpu.train.listeners import IterationListener
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """Capture a jax profiler trace around a block."""
+    with jax.profiler.trace(log_dir):
+        yield
+
+
+def latest_xplane(log_dir: str) -> Optional[str]:
+    hits = sorted(glob.glob(
+        os.path.join(log_dir, "plugins/profile/*/*.xplane.pb")))
+    return hits[-1] if hits else None
+
+
+def op_summary(log_dir: str, top: int = 20,
+               device_substr: str = "") -> List[Tuple[str, float]]:
+    """Aggregate device-op wall time from the newest trace in log_dir.
+    Returns [(op_name, seconds)] sorted desc. Needs the tensorflow xplane
+    proto (present in this image); returns [] when unavailable."""
+    path = latest_xplane(log_dir)
+    if path is None:
+        return []
+    os.environ.setdefault("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION", "python")
+    try:
+        from tensorflow.tsl.profiler.protobuf import xplane_pb2
+    except ImportError:
+        logger.warning("xplane proto unavailable; op_summary disabled")
+        return []
+    xs = xplane_pb2.XSpace()
+    with open(path, "rb") as f:
+        xs.ParseFromString(f.read())
+    stats: Counter = Counter()
+    for plane in xs.planes:
+        if not plane.name.startswith("/device:"):
+            continue
+        if device_substr and device_substr not in plane.name:
+            continue
+        meta = plane.event_metadata
+        for line in plane.lines:
+            if line.name != "XLA Ops":
+                continue
+            for ev in line.events:
+                stats[meta[ev.metadata_id].name] += ev.duration_ps / 1e12
+    return stats.most_common(top)
+
+
+def format_summary(rows: List[Tuple[str, float]]) -> str:
+    lines = ["device op time (top):"]
+    for name, sec in rows:
+        lines.append(f"  {sec * 1e3:9.3f} ms  {name[:110]}")
+    return "\n".join(lines)
+
+
+class ProfilerListener(IterationListener):
+    """Capture a trace for iterations [start, start+n_iterations) and log
+    the op summary once finished (the listener-SPI packaging of the
+    trace/parse workflow)."""
+
+    def __init__(self, log_dir: str, start_iteration: int = 10,
+                 n_iterations: int = 3, print_fn=None):
+        self.log_dir = log_dir
+        self.start = int(start_iteration)
+        self.n = int(n_iterations)
+        self.print_fn = print_fn or (lambda s: logger.info(s))
+        self._active = False
+        self.summary: List[Tuple[str, float]] = []
+
+    def iteration_done(self, model, iteration, info):
+        if iteration == self.start and not self._active:
+            jax.profiler.start_trace(self.log_dir)
+            self._active = True
+        elif self._active and iteration >= self.start + self.n:
+            # force completion of the last step before closing the trace
+            float(__import__("numpy").asarray(info["score"]()))
+            jax.profiler.stop_trace()
+            self._active = False
+            self.summary = op_summary(self.log_dir)
+            if self.summary:
+                self.print_fn(format_summary(self.summary))
