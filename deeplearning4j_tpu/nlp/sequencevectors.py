@@ -119,10 +119,21 @@ class SequenceVectors:
         if not native_mod.native_available():
             logger.warning("native corpus pipeline unavailable; "
                            "falling back to Python tokenization")
+            import re
+
+            # match corpus.cpp exactly: ASCII whitespace split and A-Z
+            # lowercasing only — the same file must produce the same
+            # vocab with or without a C++ toolchain
+            ascii_lower = str.maketrans(
+                "ABCDEFGHIJKLMNOPQRSTUVWXYZ",
+                "abcdefghijklmnopqrstuvwxyz")
+            split = re.compile("[ \t\r\n\x0b\x0c]+").split
             with open(path) as f:
-                seqs = [line.split() for line in f]
-            if lowercase:
-                seqs = [[t.lower() for t in s] for s in seqs]
+                seqs = []
+                for line in f:
+                    if lowercase:
+                        line = line.translate(ascii_lower)
+                    seqs.append([t for t in split(line) if t])
             return self.fit(seqs)
         with native_mod.NativeCorpus(path, lowercase=lowercase) as corpus:
             words, counts = corpus.vocab(self.conf.min_word_frequency)

@@ -372,7 +372,7 @@ class SelfAttentionLayer(BaseRecurrentLayerConf):
     """Multi-head self-attention over the time axis — capability BEYOND
     the reference (DL4J 0.8 predates attention; SURVEY §5 lists
     long-context as greenfield). [b, t, nIn] -> [b, t, nOut]; nOut must
-    divide n_heads. ``causal`` masks future positions. The
+    be divisible by n_heads. ``causal`` masks future positions. The
     sequence-parallel execution of the same math is
     parallel/sequence.ring_self_attention."""
 

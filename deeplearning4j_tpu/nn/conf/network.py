@@ -217,6 +217,18 @@ class MultiLayerConfiguration:
             raise ValueError("JSON does not describe a MultiLayerConfiguration")
         return obj
 
+    def to_yaml(self) -> str:
+        """reference: MultiLayerConfiguration.toYaml()."""
+        from deeplearning4j_tpu.nn.conf.serde import config_to_yaml
+
+        return config_to_yaml(self)
+
+    @staticmethod
+    def from_yaml(s: str) -> "MultiLayerConfiguration":
+        from deeplearning4j_tpu.nn.conf.serde import config_from_yaml
+
+        return config_from_yaml(s)
+
     # -- shape inference -----------------------------------------------------
     def input_types_per_layer(self):
         """List of the InputType flowing *into* each layer (after its

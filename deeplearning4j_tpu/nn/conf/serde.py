@@ -73,5 +73,20 @@ def config_to_json(obj: Any, indent: int = 2) -> str:
     return json.dumps(config_to_dict(obj), indent=indent)
 
 
+def config_to_yaml(obj: Any) -> str:
+    """YAML serde (reference: NeuralNetConfiguration.toYaml/fromYaml —
+    the same Jackson tree, different syntax). Round-trips through the
+    identical tagged-dict representation as JSON."""
+    import yaml
+
+    return yaml.safe_dump(config_to_dict(obj), sort_keys=False)
+
+
+def config_from_yaml(s: str) -> Any:
+    import yaml
+
+    return config_from_dict(yaml.safe_load(s))
+
+
 def config_from_json(s: str) -> Any:
     return config_from_dict(json.loads(s))

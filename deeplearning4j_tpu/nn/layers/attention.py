@@ -23,7 +23,7 @@ def attention_init(key, conf: L.SelfAttentionLayer, dtype):
     n_in, n_out = int(conf.n_in), int(conf.n_out)
     if n_out % conf.n_heads != 0:
         raise ValueError(
-            f"n_out {n_out} must divide n_heads {conf.n_heads}")
+            f"n_out {n_out} must be divisible by n_heads {conf.n_heads}")
     ks = jax.random.split(key, 4)
     mk = lambda k, i, o: init_weights(k, (i, o), i, o, conf.weight_init,
                                       conf.dist, dtype)

@@ -19,6 +19,7 @@ from deeplearning4j_tpu.parallel.mesh import (
 )
 from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
 from deeplearning4j_tpu.parallel.inference import InferenceMode, ParallelInference
+from deeplearning4j_tpu.parallel.tensor import shard_params_tp, tp_dense_specs
 
 __all__ = [
     "DATA_AXIS",

@@ -79,20 +79,8 @@ def ring_attention_sharded(q, k, v, *, axis_name: str = SEQ_AXIS,
     scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype)
     q_start = idx * t_local
 
-    def hop(s, carry):
-        k_cur, v_cur, acc = carry
-        kv_owner = (idx - s) % p                # whose block we hold now
-        new = _block_attend(q, k_cur, v_cur, scale=scale, causal=causal,
-                            q_start=q_start, kv_start=kv_owner * t_local)
-        acc = _merge(acc, new)
-        # pass kv to the next device in the ring (neighbor ICI link)
-        perm = [(j, (j + 1) % p) for j in range(p)]
-        k_nxt = lax.ppermute(k_cur, axis_name, perm)
-        v_nxt = lax.ppermute(v_cur, axis_name, perm)
-        return k_nxt, v_nxt, acc
-
     B, T, H, D = q.shape
-    init = (
+    acc = (
         jnp.full((B, H, T), -jnp.inf, q.dtype),
         jnp.zeros((B, H, T), q.dtype),
         jnp.zeros((B, T, H, D), q.dtype),
@@ -100,12 +88,22 @@ def ring_attention_sharded(q, k, v, *, axis_name: str = SEQ_AXIS,
     # the accumulator becomes device-varying after the first hop; mark the
     # (device-constant) init accordingly for shard_map's axis typing
     if hasattr(lax, "pvary"):
-        init = jax.tree_util.tree_map(
-            lambda a: lax.pvary(a, (axis_name,)), init)
-    # note: the hop count is static (p); lax.fori_loop keeps one compiled
-    # body with the collective inside — XLA pipelines permute with compute
-    _, _, (m, l, o) = lax.fori_loop(
-        0, p, hop, (k, v, init))
+        acc = jax.tree_util.tree_map(
+            lambda a: lax.pvary(a, (axis_name,)), acc)
+    # static unroll over the (small, known) ring size: lets XLA overlap
+    # each hop's permute with the previous hop's attention, and skips the
+    # final rotation whose result nobody reads
+    perm = [(j, (j + 1) % p) for j in range(p)]
+    k_cur, v_cur = k, v
+    for s in range(p):
+        kv_owner = (idx - s) % p                # whose block we hold now
+        new = _block_attend(q, k_cur, v_cur, scale=scale, causal=causal,
+                            q_start=q_start, kv_start=kv_owner * t_local)
+        acc = _merge(acc, new)
+        if s < p - 1:  # last hop: kv would never be read again
+            k_cur = lax.ppermute(k_cur, axis_name, perm)
+            v_cur = lax.ppermute(v_cur, axis_name, perm)
+    m, l, o = acc
     l = jnp.maximum(l, 1e-20)
     return o / jnp.moveaxis(l, 1, -1)[..., None]
 

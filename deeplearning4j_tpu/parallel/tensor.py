@@ -1,0 +1,88 @@
+"""Tensor (model) parallelism over the "model" mesh axis.
+
+NEW capability beyond the reference (SURVEY §2.4: DL4J ships data
+parallelism only). TPU-native TP is declarative: parameters carry
+NamedShardings over the "model" axis and XLA GSPMD inserts the
+all-gathers/reduce-scatters — there is no hand-written collective code to
+maintain. The canonical pattern (Megatron split):
+
+  layer i   (column-parallel): W1 [E, F] sharded on F -> local activations
+  layer i+1 (row-parallel):    W2 [F, E] sharded on F -> psum over "model"
+
+``shard_params_tp`` applies that column/row alternation to a
+MultiLayerNetwork's dense stack in place; the jitted train step is
+unchanged — GSPMD propagates the shardings through forward, backward and
+the updater. Combine with the "data" axis (mesh_2d) for DP+TP.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.parallel.mesh import MODEL_AXIS
+
+
+def tp_dense_specs(layer_confs: List, axis: str = MODEL_AXIS):
+    """PartitionSpec per layer-param for the alternating column/row split
+    of consecutive Dense layers; everything else replicated. Output
+    layers stay replicated (their nOut is the tiny class count)."""
+    specs = []
+    col = True  # start column-parallel
+    for lc in layer_confs:
+        inner = lc.inner if isinstance(lc, L.FrozenLayer) else lc
+        if isinstance(inner, L.DenseLayer):
+            if col:
+                specs.append({"W": PartitionSpec(None, axis),
+                              "b": PartitionSpec(axis)})
+            else:
+                specs.append({"W": PartitionSpec(axis, None),
+                              "b": PartitionSpec()})
+            col = not col
+        else:
+            specs.append(None)  # replicated
+    return specs
+
+
+def shard_params_tp(net, mesh: Mesh, axis: str = MODEL_AXIS):
+    """Place a network's parameters (and updater state) with TP shardings
+    over `mesh`. Training/inference then run tensor-parallel with no
+    further code changes (GSPMD). Returns the per-layer specs used."""
+    specs = tp_dense_specs(net.layer_confs, axis)
+    rep = NamedSharding(mesh, PartitionSpec())
+
+    def place(p, spec):
+        out = {}
+        for k, v in p.items():
+            s = (spec or {}).get(k)
+            sh = NamedSharding(mesh, s) if s is not None else rep
+            out[k] = jax.device_put(v, sh)
+        return out
+
+    net.params_list = [
+        place(p, s) for p, s in zip(net.params_list, specs)
+    ]
+
+    # updater state mirrors the param tree one level down (per-layer dicts
+    # of per-param state pytrees) — shard it identically so moments stay
+    # aligned with their parameters
+    def place_state(st, spec):
+        if st is None:
+            return None
+        out = {}
+        for k, v in st.items():
+            s = (spec or {}).get(k)
+            sh = NamedSharding(mesh, s) if s is not None else rep
+            out[k] = jax.tree_util.tree_map(
+                lambda a: jax.device_put(a, sh), v)
+        return out
+
+    if net.upd_state is not None:
+        net.upd_state = [
+            place_state(st, s) for st, s in zip(net.upd_state, specs)
+        ]
+    return specs

@@ -56,7 +56,9 @@ class CheckpointListener(IterationListener):
         self.save_updater = save_updater
         self._last_time = time.monotonic()
         self._model = None
-        self._lock = threading.Lock()
+        # RLock: the SIGTERM handler may interrupt an in-flight
+        # save on the same thread and must not deadlock
+        self._lock = threading.RLock()
         self._prev_sigterm = None
         if save_on_preemption:
             self._install_preemption_hook()

@@ -98,8 +98,18 @@ class ProfilerListener(IterationListener):
         elif self._active and iteration >= self.start + self.n:
             # force completion of the last step before closing the trace
             float(__import__("numpy").asarray(info["score"]()))
-            jax.profiler.stop_trace()
-            self._active = False
-            self.summary = op_summary(self.log_dir)
-            if self.summary:
-                self.print_fn(format_summary(self.summary))
+            self._finalize()
+
+    def on_epoch_end(self, model, epoch):
+        # training may end before the window closes — never leave the
+        # process-global profiler running (a dangling trace blocks every
+        # later start_trace and loses the xplane)
+        if self._active:
+            self._finalize()
+
+    def _finalize(self):
+        jax.profiler.stop_trace()
+        self._active = False
+        self.summary = op_summary(self.log_dir)
+        if self.summary:
+            self.print_fn(format_summary(self.summary))

@@ -159,3 +159,20 @@ def test_same_mode_shapes():
 def test_unknown_type_tag_raises():
     with pytest.raises(ValueError):
         MultiLayerConfiguration.from_json('{"type": "layer.bogus_thing"}')
+
+
+def test_yaml_round_trip():
+    """reference: NeuralNetConfiguration toYaml/fromYaml."""
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+
+    conf = (NeuralNetConfiguration.builder().seed(9).updater("adam")
+            .learning_rate(0.01).list()
+            .layer(DenseLayer(n_out=7, activation="relu"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(5)).build())
+    text = conf.to_yaml()
+    assert "layer.dense" in text
+    back = type(conf).from_yaml(text)
+    assert back.to_json() == conf.to_json()
