@@ -135,16 +135,17 @@ def bench_lenet(batch=512, steps=30):
 def bench_char_lstm(batch=64, seq_len=200, tbptt=50, vocab=77, hidden=200,
                     steps=6):
     """tokens/sec through the TBPTT fit path (each fit batch = seq_len/tbptt
-    optimizer steps)."""
+    optimizer steps). Tries the fused Pallas LSTM helper first; if the
+    kernel fails to lower on this backend the helper is disabled and the
+    scan path is measured instead (reported via `kernel`)."""
     from deeplearning4j_tpu.models.charlstm import char_lstm_conf
     from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.ops.helpers import set_helper_enabled
 
     on_tpu = jax.default_backend() not in ("cpu",)
     if not on_tpu:
         batch, seq_len, steps, hidden = 16, 100, 3, 64
-    conf = char_lstm_conf(vocab_size=vocab, hidden=hidden, tbptt_length=tbptt,
-                          precision="bf16" if on_tpu else "f32")
-    net = MultiLayerNetwork(conf).init()
+
     rng = np.random.default_rng(0)
     idx = rng.integers(0, vocab, (batch, seq_len))
     x = np.eye(vocab, dtype=np.float32)[idx]
@@ -152,7 +153,37 @@ def bench_char_lstm(batch=64, seq_len=200, tbptt=50, vocab=77, hidden=200,
     y = np.eye(vocab, dtype=np.float32)[yidx]
     ds = _device_dataset(x, y)
     segments = -(-seq_len // tbptt)
-    dt, n_steps = _time_fit(net, lambda k: ExistingDataSetIterator([ds] * k), steps)
+
+    def run():
+        conf = char_lstm_conf(vocab_size=vocab, hidden=hidden,
+                              tbptt_length=tbptt,
+                              precision="bf16" if on_tpu else "f32")
+        net = MultiLayerNetwork(conf).init()
+        dt, n_steps = _time_fit(
+            net, lambda k: ExistingDataSetIterator([ds] * k), steps)
+        return conf, dt, n_steps
+
+    from deeplearning4j_tpu.ops.helpers import get_helper
+
+    probe = get_helper("lstm_sequence", peephole=True, mask=None,
+                       gate_act="sigmoid", cell_act="tanh", reverse=False)
+    kernel = "pallas_fused_lstm" if probe is not None else "lax_scan"
+    kernel_error = None
+    try:
+        conf, dt, n_steps = run()
+    except Exception as e:  # pallas lowering failure: measure scan path
+        import sys
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        kernel_error = f"{type(e).__name__}: {e}"
+        set_helper_enabled("lstm_sequence", False)
+        try:
+            kernel = "lax_scan_fallback"
+            conf, dt, n_steps = run()
+        finally:
+            # never leak a disabled helper to later library callers
+            set_helper_enabled("lstm_sequence", True)
     fit_batches = n_steps / segments
     tokens = batch * seq_len * fit_batches / dt
     fwd = mln_forward_flops(conf)  # per example, per timestep (no ts set)
@@ -165,6 +196,8 @@ def bench_char_lstm(batch=64, seq_len=200, tbptt=50, vocab=77, hidden=200,
         "seq_len": seq_len,
         "tbptt": tbptt,
         "hidden": hidden,
+        "kernel": kernel,
+        **({"kernel_error": kernel_error} if kernel_error else {}),
         "seconds": round(dt, 3),
         "mfu": None if mfu is None else round(mfu, 4),
     }

@@ -96,7 +96,13 @@ def _scan_lstm(conf, params, x, ctx, peephole: bool, prefix: str = "", reverse: 
         reverse=reverse,
     )
     if helper is not None:
-        ys, hF, cF = helper(xg_t, RW.astype(x.dtype), h0, c0)
+        if peephole:
+            pv = tuple(params[prefix + k].astype(x.dtype)
+                       for k in ("pI", "pF", "pO"))
+        else:
+            zero = jnp.zeros((H,), x.dtype)
+            pv = (zero, zero, zero)
+        ys, hF, cF = helper(xg_t, RW.astype(x.dtype), *pv, h0, c0)
         return jnp.swapaxes(ys, 0, 1), (hF, cF)
 
     mask = ctx.mask

@@ -118,11 +118,29 @@ def vae_elbo(conf: L.VariationalAutoencoder, params, x, rng, training=True):
                 + 2.0 * r_logstd + math.log(2.0 * math.pi),
                 axis=-1,
             )
-        else:  # bernoulli
+        elif kind == "exponential":
+            # reference: ExponentialReconstructionDistribution — the
+            # activation of the decoder preout gives log(lambda);
+            # -log p(x) = -log(lambda) + lambda*x
+            log_lambda = apply_activation(
+                dist.get("activation", "identity"), out)
+            nll = jnp.sum(-log_lambda + jnp.exp(log_lambda) * x, axis=-1)
+        elif kind == "loss_wrapper":
+            # reference: LossFunctionWrapper — any ILossFunction as the
+            # reconstruction objective (per-example value)
+            from deeplearning4j_tpu.ops.losses import loss_value
+
+            nll = loss_value(dist.get("loss", "mse"), x, out,
+                             dist.get("activation", "identity"), None)
+        elif kind == "bernoulli":
             # stable from logits
             nll = jnp.sum(
                 x * jax.nn.softplus(-out) + (1.0 - x) * jax.nn.softplus(out), axis=-1
             )
+        else:
+            raise ValueError(
+                f"unknown reconstruction distribution {kind!r} "
+                "(gaussian | bernoulli | exponential | loss_wrapper)")
         recon = recon + nll
     recon = recon / n_samples
     return recon + kl

@@ -9,19 +9,23 @@ sequence in one pallas_call: grid over time, h/c/RW resident in VMEM
 across grid steps (TPU grids execute sequentially, scratch persists), so
 HBM traffic is just xg in / y out.
 
-Scope (checked by the helper probe, scan fallback otherwise): sigmoid
-gates + tanh cell, no peepholes, no time mask. Gate blocks [i,f,g,o] as in
-recurrent.py.
+Peepholes (GravesLSTM — the char-rnn baseline model) are first-class:
+pI/pF feed the input/forget gates from c_{t-1}, pO feeds the output gate
+from c_t, matching nn/layers/recurrent.py's Graves formulation. Plain
+LSTM passes zero vectors (the [H] vector work is negligible and keeps
+one kernel).
 
-Backward is a second reverse-time kernel (custom_vjp): recomputes c_t from
-saved post-activation gates, accumulates dRW in VMEM, emits per-step
-dgate-preactivations (dxg) from which autodiff outside the kernel derives
-dW/db/dx through the big batched input projection.
+Scope (checked by the helper probe, scan fallback otherwise): sigmoid
+gates + tanh cell, no time mask, forward direction. Gate blocks
+[i,f,g,o] as in recurrent.py.
+
+Backward is a second reverse-time kernel (custom_vjp): recomputes c_t
+from saved post-activation gates, accumulates dRW/dpI/dpF/dpO in VMEM,
+emits per-step dgate-preactivations (dxg) from which autodiff outside
+the kernel derives dW/db/dx through the big batched input projection.
 """
 
 from __future__ import annotations
-
-from functools import partial
 
 import jax
 import jax.numpy as jnp
@@ -31,7 +35,7 @@ from jax.experimental.pallas import tpu as pltpu
 _INTERPRET = False  # flipped by tests on CPU
 
 
-def _fwd_kernel(xg_ref, rw_ref, h0_ref, c0_ref,
+def _fwd_kernel(xg_ref, rw_ref, pi_ref, pf_ref, po_ref, h0_ref, c0_ref,
                 y_ref, acts_ref, hprev_ref, cprev_ref,
                 h_scr, c_scr):
     t = pl.program_id(0)
@@ -50,11 +54,14 @@ def _fwd_kernel(xg_ref, rw_ref, h0_ref, c0_ref,
     pre = xg_ref[0].astype(jnp.float32) + jnp.dot(
         h, rw_ref[:].astype(jnp.float32),
         preferred_element_type=jnp.float32)
-    i = jax.nn.sigmoid(pre[:, :H])
-    f = jax.nn.sigmoid(pre[:, H:2 * H])
+    pi = pi_ref[0].astype(jnp.float32)
+    pf = pf_ref[0].astype(jnp.float32)
+    po = po_ref[0].astype(jnp.float32)
+    i = jax.nn.sigmoid(pre[:, :H] + c * pi)
+    f = jax.nn.sigmoid(pre[:, H:2 * H] + c * pf)
     g = jnp.tanh(pre[:, 2 * H:3 * H])
-    o = jax.nn.sigmoid(pre[:, 3 * H:])
     c_new = f * c + i * g
+    o = jax.nn.sigmoid(pre[:, 3 * H:] + c_new * po)
     h_new = o * jnp.tanh(c_new)
 
     acts_ref[0] = jnp.concatenate([i, f, g, o], axis=-1).astype(acts_ref.dtype)
@@ -64,57 +71,71 @@ def _fwd_kernel(xg_ref, rw_ref, h0_ref, c0_ref,
 
 
 def _bwd_kernel(acts_ref, hprev_ref, cprev_ref, rw_ref,
-                dy_ref, dhF_ref, dcF_ref,
-                dxg_ref, drw_ref, dh0_ref, dc0_ref,
-                dh_scr, dc_scr, drw_scr):
+                pi_ref, pf_ref, po_ref, dy_ref, dcF_ref,
+                dxg_ref, drw_ref, dpi_ref, dpf_ref, dpo_ref,
+                dh0_ref, dc0_ref,
+                dh_scr, dc_scr, drw_scr, dp_scr):
     k = pl.program_id(0)           # 0 .. T-1, walking time BACKWARD
     T = pl.num_programs(0)
     H = dh0_ref.shape[-1]
 
     @pl.when(k == 0)
     def _():
-        dh_scr[:] = dhF_ref[:].astype(jnp.float32)
+        dh_scr[:] = jnp.zeros_like(dh_scr)
         dc_scr[:] = dcF_ref[:].astype(jnp.float32)
         drw_scr[:] = jnp.zeros_like(drw_scr)
+        dp_scr[:] = jnp.zeros_like(dp_scr)
 
     acts = acts_ref[0].astype(jnp.float32)
     i, f = acts[:, :H], acts[:, H:2 * H]
     g, o = acts[:, 2 * H:3 * H], acts[:, 3 * H:]
     hprev = hprev_ref[0].astype(jnp.float32)
     cprev = cprev_ref[0].astype(jnp.float32)
+    pi = pi_ref[0].astype(jnp.float32)
+    pf = pf_ref[0].astype(jnp.float32)
+    po = po_ref[0].astype(jnp.float32)
 
     dh = dh_scr[:] + dy_ref[0].astype(jnp.float32)
     c_t = f * cprev + i * g        # recomputed, not stored
     tc = jnp.tanh(c_t)
     do = dh * tc
-    dc = dh * o * (1.0 - tc * tc) + dc_scr[:]
+    dpre_o = do * o * (1.0 - o)
+    # dc collects: tanh path, next-step carry, and the output peephole
+    dc = dh * o * (1.0 - tc * tc) + dc_scr[:] + dpre_o * po
     di = dc * g
     dg = dc * i
     df = dc * cprev
-    dpre = jnp.concatenate([
-        di * i * (1.0 - i),
-        df * f * (1.0 - f),
-        dg * (1.0 - g * g),
-        do * o * (1.0 - o),
-    ], axis=-1)                                       # [B, 4H]
+    dpre_i = di * i * (1.0 - i)
+    dpre_f = df * f * (1.0 - f)
+    dpre_g = dg * (1.0 - g * g)
+    dpre = jnp.concatenate([dpre_i, dpre_f, dpre_g, dpre_o], axis=-1)
 
     dxg_ref[0] = dpre.astype(dxg_ref.dtype)
     drw_scr[:] += jnp.dot(hprev.T, dpre, preferred_element_type=jnp.float32)
+    # peephole grads: rows 0/1/2 of dp_scr = dpI/dpF/dpO ([1, H] sums)
+    dp_scr[0, :] += jnp.sum(dpre_i * cprev, axis=0)
+    dp_scr[1, :] += jnp.sum(dpre_f * cprev, axis=0)
+    dp_scr[2, :] += jnp.sum(dpre_o * c_t, axis=0)
     dh_scr[:] = jnp.dot(dpre, rw_ref[:].astype(jnp.float32).T,
                         preferred_element_type=jnp.float32)
-    dc_scr[:] = dc * f
+    dc_scr[:] = dc * f + dpre_i * pi + dpre_f * pf
 
     @pl.when(k == T - 1)
     def _():
         drw_ref[:] = drw_scr[:].astype(drw_ref.dtype)
+        dpi_ref[0] = dp_scr[0, :].astype(dpi_ref.dtype)
+        dpf_ref[0] = dp_scr[1, :].astype(dpf_ref.dtype)
+        dpo_ref[0] = dp_scr[2, :].astype(dpo_ref.dtype)
         dh0_ref[:] = dh_scr[:].astype(dh0_ref.dtype)
         dc0_ref[:] = dc_scr[:].astype(dc0_ref.dtype)
 
 
-def _fwd_call(xg, rw, h0, c0):
+def _fwd_call(xg, rw, pI, pF, pO, h0, c0):
     T, B, H4 = xg.shape
     H = H4 // 4
     dt = xg.dtype
+    vec = lambda: pl.BlockSpec((1, H), lambda t: (0, 0),
+                               memory_space=pltpu.VMEM)
     y, acts, hprev, cprev = pl.pallas_call(
         _fwd_kernel,
         grid=(T,),
@@ -123,6 +144,7 @@ def _fwd_call(xg, rw, h0, c0):
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((H, H4), lambda t: (0, 0),
                          memory_space=pltpu.VMEM),
+            vec(), vec(), vec(),
             pl.BlockSpec((B, H), lambda t: (0, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((B, H), lambda t: (0, 0),
@@ -149,42 +171,41 @@ def _fwd_call(xg, rw, h0, c0):
             pltpu.VMEM((B, H), jnp.float32),
         ],
         interpret=_INTERPRET,
-    )(xg, rw, h0, c0)
+    )(xg, rw, pI[None, :], pF[None, :], pO[None, :], h0, c0)
     return y, acts, hprev, cprev
 
 
-def _bwd_call(acts, hprev, cprev, rw, dy, dhF, dcF):
+def _bwd_call(acts, hprev, cprev, rw, pI, pF, pO, dy, dcF):
     T, B, H4 = acts.shape
     H = H4 // 4
     dt = acts.dtype
     rev = lambda t: (T - 1 - t, 0, 0)
-    dxg, drw, dh0, dc0 = pl.pallas_call(
+    fixed = lambda shape: pl.BlockSpec(shape, lambda t: (0,) * len(shape),
+                                       memory_space=pltpu.VMEM)
+    dxg, drw, dpi, dpf, dpo, dh0, dc0 = pl.pallas_call(
         _bwd_kernel,
         grid=(T,),
         in_specs=[
             pl.BlockSpec((1, B, H4), rev, memory_space=pltpu.VMEM),
             pl.BlockSpec((1, B, H), rev, memory_space=pltpu.VMEM),
             pl.BlockSpec((1, B, H), rev, memory_space=pltpu.VMEM),
-            pl.BlockSpec((H, H4), lambda t: (0, 0),
-                         memory_space=pltpu.VMEM),
+            fixed((H, H4)),
+            fixed((1, H)), fixed((1, H)), fixed((1, H)),
             pl.BlockSpec((1, B, H), rev, memory_space=pltpu.VMEM),
-            pl.BlockSpec((B, H), lambda t: (0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((B, H), lambda t: (0, 0),
-                         memory_space=pltpu.VMEM),
+            fixed((B, H)),
         ],
         out_specs=[
             pl.BlockSpec((1, B, H4), rev, memory_space=pltpu.VMEM),
-            pl.BlockSpec((H, H4), lambda t: (0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((B, H), lambda t: (0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((B, H), lambda t: (0, 0),
-                         memory_space=pltpu.VMEM),
+            fixed((H, H4)),
+            fixed((1, H)), fixed((1, H)), fixed((1, H)),
+            fixed((B, H)), fixed((B, H)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((T, B, H4), dt),
             jax.ShapeDtypeStruct((H, H4), jnp.float32),
+            jax.ShapeDtypeStruct((1, H), jnp.float32),
+            jax.ShapeDtypeStruct((1, H), jnp.float32),
+            jax.ShapeDtypeStruct((1, H), jnp.float32),
             jax.ShapeDtypeStruct((B, H), dt),
             jax.ShapeDtypeStruct((B, H), dt),
         ],
@@ -192,51 +213,56 @@ def _bwd_call(acts, hprev, cprev, rw, dy, dhF, dcF):
             pltpu.VMEM((B, H), jnp.float32),
             pltpu.VMEM((B, H), jnp.float32),
             pltpu.VMEM((H, H4), jnp.float32),
+            pltpu.VMEM((3, H), jnp.float32),
         ],
         interpret=_INTERPRET,
-    )(acts, hprev, cprev, rw, dy, dhF, dcF)
-    return dxg, drw, dh0, dc0
+    )(acts, hprev, cprev, rw, pI[None, :], pF[None, :], pO[None, :],
+      dy, dcF)
+    return dxg, drw, dpi[0], dpf[0], dpo[0], dh0, dc0
 
 
 @jax.custom_vjp
-def lstm_sequence(xg, rw, h0, c0):
-    """Fused LSTM over a whole sequence.
+def lstm_sequence(xg, rw, pI, pF, pO, h0, c0):
+    """Fused (peephole-capable) LSTM over a whole sequence.
 
     xg: [T, B, 4H] precomputed input projections + bias (time-major).
-    rw: [H, 4H] recurrent weights. h0/c0: [B, H].
+    rw: [H, 4H] recurrent weights. pI/pF/pO: [H] peephole vectors (zeros
+    for plain LSTM). h0/c0: [B, H].
     Returns (y [T, B, H], hF, cF)."""
-    out, _ = _lstm_fwd(xg, rw, h0, c0)
+    out, _ = _lstm_fwd(xg, rw, pI, pF, pO, h0, c0)
     return out
 
 
-def _lstm_fwd(xg, rw, h0, c0):
-    y, acts, hprev, cprev = _fwd_call(xg, rw, h0, c0)
+def _lstm_fwd(xg, rw, pI, pF, pO, h0, c0):
+    y, acts, hprev, cprev = _fwd_call(xg, rw, pI, pF, pO, h0, c0)
     H = rw.shape[0]
     a_last = acts[-1].astype(jnp.float32)
     cF = (a_last[:, H:2 * H] * cprev[-1].astype(jnp.float32)
           + a_last[:, :H] * a_last[:, 2 * H:3 * H]).astype(y.dtype)
-    return (y, y[-1], cF), (acts, hprev, cprev, rw)
+    return (y, y[-1], cF), (acts, hprev, cprev, rw, pI, pF, pO)
 
 
 def _lstm_bwd(res, cts):
-    acts, hprev, cprev, rw = res
+    acts, hprev, cprev, rw, pI, pF, pO = res
     dy, dhF, dcF = cts
     # the hF cotangent folds into the last dy row; dcF enters the kernel
     dy = dy.at[-1].add(dhF.astype(dy.dtype))
-    zero_h = jnp.zeros_like(dy[0])
-    dxg, drw, dh0, dc0 = _bwd_call(
-        acts, hprev, cprev, rw, dy, zero_h, dcF.astype(dy.dtype))
-    return dxg, drw.astype(rw.dtype), dh0, dc0
+    dxg, drw, dpi, dpf, dpo, dh0, dc0 = _bwd_call(
+        acts, hprev, cprev, rw, pI, pF, pO, dy, dcF.astype(dy.dtype))
+    return (dxg, drw.astype(rw.dtype), dpi.astype(pI.dtype),
+            dpf.astype(pF.dtype), dpo.astype(pO.dtype), dh0, dc0)
 
 
 lstm_sequence.defvjp(_lstm_fwd, _lstm_bwd)
 
 
 def supported(*, peephole, mask, gate_act, cell_act, reverse, **_):
-    """Helper probe: the fused kernel covers the standard configuration;
-    anything else falls back to the scan path (reference: cuDNN helper
+    """Helper probe: the fused kernel covers sigmoid gates + tanh cell,
+    forward direction, no time mask (with or without peepholes); anything
+    else falls back to the scan path (reference: cuDNN helper
     checkSupported fallback)."""
-    if peephole or reverse or mask is not None:
+    del peephole  # both variants supported
+    if reverse or mask is not None:
         return False
     if gate_act not in ("sigmoid",) or cell_act not in ("tanh",):
         return False

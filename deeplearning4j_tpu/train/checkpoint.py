@@ -56,9 +56,7 @@ class CheckpointListener(IterationListener):
         self.save_updater = save_updater
         self._last_time = time.monotonic()
         self._model = None
-        # RLock: the SIGTERM handler may interrupt an in-flight
-        # save on the same thread and must not deadlock
-        self._lock = threading.RLock()
+        self._lock = threading.Lock()
         self._prev_sigterm = None
         if save_on_preemption:
             self._install_preemption_hook()
@@ -82,13 +80,21 @@ class CheckpointListener(IterationListener):
 
     # -- saving ---------------------------------------------------------------
 
-    def save(self, model, reason: str = "manual") -> str:
+    def save(self, model, reason: str = "manual",
+             blocking: bool = True) -> Optional[str]:
+        """blocking=False (the SIGTERM handler) skips instead of waiting:
+        if a save is already mid-write on this thread, re-entering would
+        corrupt it — and its result is at most one interval stale."""
         from deeplearning4j_tpu.utils.model_serializer import save_model
 
-        with self._lock:
+        if not self._lock.acquire(blocking=blocking):
+            logger.warning("checkpoint save already in flight; skipping "
+                           "(%s)", reason)
+            return None
+        try:
             name = f"checkpoint_iter{model.iteration:09d}.zip"
             path = os.path.join(self.dir, name)
-            tmp = path + ".tmp"
+            tmp = f"{path}.{os.getpid()}.{reason}.tmp"  # unique per writer
             save_model(model, tmp, save_updater=self.save_updater)
             os.replace(tmp, path)  # atomic: never a torn checkpoint
             meta = {
@@ -104,6 +110,8 @@ class CheckpointListener(IterationListener):
             self._gc()
             logger.info("checkpoint saved: %s (%s)", path, reason)
             return path
+        finally:
+            self._lock.release()
 
     def _gc(self):
         if self.keep_last <= 0:
@@ -129,7 +137,7 @@ class CheckpointListener(IterationListener):
             model = self._model
             if model is not None:
                 try:
-                    self.save(model, reason="preemption")
+                    self.save(model, reason="preemption", blocking=False)
                 except Exception:
                     logger.exception("preemption save failed")
             if callable(self._prev_sigterm):

@@ -103,8 +103,15 @@ class ProfilerListener(IterationListener):
     def on_epoch_end(self, model, epoch):
         # training may end before the window closes — never leave the
         # process-global profiler running (a dangling trace blocks every
-        # later start_trace and loses the xplane)
+        # later start_trace and loses the xplane). A window that spans an
+        # epoch boundary is finalized early, with a warning — place the
+        # window inside one epoch for a full capture.
         if self._active:
+            logger.warning(
+                "profiler window truncated at epoch end (captured fewer "
+                "than n_iterations=%d steps)", self.n)
+            if model._score is not None:  # complete the in-flight step
+                float(__import__("numpy").asarray(model._score))
             self._finalize()
 
     def _finalize(self):

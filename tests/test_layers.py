@@ -251,3 +251,41 @@ def test_conv1d_and_subsampling1d():
     sub = L.Subsampling1DLayer(kernel_size=2, stride=2)
     y2, _ = forward_layer(sub, {}, y, LayerContext())
     assert y2.shape == (2, 4, 6)
+
+
+def test_vae_reconstruction_distribution_set():
+    """Reference parity: the ReconstructionDistribution family
+    (Gaussian/Bernoulli/Exponential/LossFunctionWrapper —
+    nn/conf/layers/variational/)."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.nn.conf import layers as L
+    from deeplearning4j_tpu.nn.layers.special import vae_elbo, vae_init
+
+    rng = np.random.default_rng(0)
+    x01 = jnp.asarray(rng.random((6, 8)), jnp.float32)  # in [0,1]
+    for dist in ({"type": "bernoulli"},
+                 {"type": "gaussian", "activation": "identity"},
+                 {"type": "exponential"},
+                 {"type": "loss_wrapper", "loss": "mse",
+                  "activation": "sigmoid"}):
+        conf = L.VariationalAutoencoder(
+            n_in=8, n_out=3, encoder_layer_sizes=[10],
+            decoder_layer_sizes=[10], activation="tanh",
+            weight_init="xavier", pzx_activation="identity",
+            reconstruction_distribution=dist)
+        params = vae_init(jax.random.PRNGKey(0), conf, jnp.float32)
+        elbo = vae_elbo(conf, params, x01, jax.random.PRNGKey(1))
+        assert elbo.shape == (6,)
+        assert bool(jnp.isfinite(elbo).all()), dist
+    import pytest as _pytest
+
+    conf = L.VariationalAutoencoder(
+        n_in=8, n_out=3, encoder_layer_sizes=[10],
+        decoder_layer_sizes=[10], weight_init="xavier",
+        activation="tanh", pzx_activation="identity",
+        reconstruction_distribution={"type": "nope"})
+    params = vae_init(jax.random.PRNGKey(0), conf, jnp.float32)
+    with _pytest.raises(ValueError, match="unknown reconstruction"):
+        vae_elbo(conf, params, x01, jax.random.PRNGKey(1))

@@ -27,16 +27,16 @@ def _interpret_mode():
     pallas_lstm._INTERPRET = old
 
 
-def _scan_reference(xg_t, rw, h0, c0):
+def _scan_reference(xg_t, rw, pI, pF, pO, h0, c0):
     def step(carry, g_in):
         h, c = carry
         g = g_in + h @ rw
         H = h.shape[-1]
-        i = jax.nn.sigmoid(g[:, :H])
-        f = jax.nn.sigmoid(g[:, H:2 * H])
+        i = jax.nn.sigmoid(g[:, :H] + c * pI)
+        f = jax.nn.sigmoid(g[:, H:2 * H] + c * pF)
         gg = jnp.tanh(g[:, 2 * H:3 * H])
-        o = jax.nn.sigmoid(g[:, 3 * H:])
         c_new = f * c + i * gg
+        o = jax.nn.sigmoid(g[:, 3 * H:] + c_new * pO)
         h_new = o * jnp.tanh(c_new)
         return (h_new, c_new), h_new
 
@@ -44,34 +44,42 @@ def _scan_reference(xg_t, rw, h0, c0):
     return ys, hF, cF
 
 
-def test_kernel_matches_scan_forward_and_grad():
+@pytest.mark.parametrize("with_peepholes", [False, True])
+def test_kernel_matches_scan_forward_and_grad(with_peepholes):
     rng = np.random.default_rng(0)
     T, B, H = 5, 8, 16
     xg = jnp.asarray(rng.standard_normal((T, B, 4 * H)), jnp.float32)
     rw = jnp.asarray(rng.standard_normal((H, 4 * H)) * 0.2, jnp.float32)
+    if with_peepholes:
+        pI, pF, pO = (jnp.asarray(rng.standard_normal(H) * 0.3, jnp.float32)
+                      for _ in range(3))
+    else:
+        pI = pF = pO = jnp.zeros((H,), jnp.float32)
     h0 = jnp.asarray(rng.standard_normal((B, H)) * 0.1, jnp.float32)
     c0 = jnp.asarray(rng.standard_normal((B, H)) * 0.1, jnp.float32)
 
-    y1, hF1, cF1 = pallas_lstm.lstm_sequence(xg, rw, h0, c0)
-    y2, hF2, cF2 = _scan_reference(xg, rw, h0, c0)
+    y1, hF1, cF1 = pallas_lstm.lstm_sequence(xg, rw, pI, pF, pO, h0, c0)
+    y2, hF2, cF2 = _scan_reference(xg, rw, pI, pF, pO, h0, c0)
     np.testing.assert_allclose(np.asarray(y1), np.asarray(y2),
                                rtol=1e-5, atol=1e-5)
     np.testing.assert_allclose(np.asarray(cF1), np.asarray(cF2),
                                rtol=1e-5, atol=1e-5)
 
-    def loss_k(xg, rw, h0, c0):
-        y, hF, cF = pallas_lstm.lstm_sequence(xg, rw, h0, c0)
+    def loss_k(*a):
+        y, hF, cF = pallas_lstm.lstm_sequence(*a)
         return (jnp.sum(y * y) + jnp.sum(jnp.sin(hF))
                 + jnp.sum(jnp.cos(cF)))
 
-    def loss_s(xg, rw, h0, c0):
-        y, hF, cF = _scan_reference(xg, rw, h0, c0)
+    def loss_s(*a):
+        y, hF, cF = _scan_reference(*a)
         return (jnp.sum(y * y) + jnp.sum(jnp.sin(hF))
                 + jnp.sum(jnp.cos(cF)))
 
-    g1 = jax.grad(loss_k, argnums=(0, 1, 2, 3))(xg, rw, h0, c0)
-    g2 = jax.grad(loss_s, argnums=(0, 1, 2, 3))(xg, rw, h0, c0)
-    for a, b, name in zip(g1, g2, ("dxg", "drw", "dh0", "dc0")):
+    args = (xg, rw, pI, pF, pO, h0, c0)
+    g1 = jax.grad(loss_k, argnums=tuple(range(7)))(*args)
+    g2 = jax.grad(loss_s, argnums=tuple(range(7)))(*args)
+    names = ("dxg", "drw", "dpI", "dpF", "dpO", "dh0", "dc0")
+    for a, b, name in zip(g1, g2, names):
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-4,
             err_msg=f"gradient mismatch in {name}")
@@ -83,8 +91,12 @@ def test_helper_registered_and_probed():
     assert get_helper("lstm_sequence", peephole=False, mask=None,
                       gate_act="sigmoid", cell_act="tanh",
                       reverse=False) is not None
+    # peepholes ARE supported (GravesLSTM, the char-rnn baseline model)
+    assert get_helper("lstm_sequence", peephole=True, mask=None,
+                      gate_act="sigmoid", cell_act="tanh",
+                      reverse=False) is not None
     # fallback cases
-    for ctx in (dict(peephole=True), dict(mask=np.ones((2, 3))),
+    for ctx in (dict(mask=np.ones((2, 3))),
                 dict(gate_act="hardsigmoid"), dict(cell_act="relu"),
                 dict(reverse=True)):
         base = dict(peephole=False, mask=None, gate_act="sigmoid",
@@ -142,3 +154,46 @@ def test_network_lstm_uses_helper_and_matches_scan():
             np.testing.assert_allclose(
                 np.asarray(p1[k]), np.asarray(p2[k]), rtol=2e-4, atol=2e-5,
                 err_msg=f"param {k}")
+
+
+def test_network_tbptt_uses_helper_and_matches_scan():
+    """TBPTT segment training with a GravesLSTM (peepholes — the char-rnn
+    bench model) through the fused kernel equals the scan path — state
+    carry (h0/c0 in, hF/cF out) crosses the kernel boundary correctly."""
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    from deeplearning4j_tpu.nn.conf.layers import GravesLSTM, RnnOutputLayer
+    from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    def build():
+        conf = (NeuralNetConfiguration.builder().seed(9)
+                .weight_init("xavier").learning_rate(0.1)
+                .list()
+                .backprop_type("tbptt")
+                .t_bptt_lengths(8)  # 2 segments over T=16
+                .layer(GravesLSTM(n_out=10, activation="tanh"))
+                .layer(RnnOutputLayer(n_out=3, activation="softmax",
+                                      loss="mcxent"))
+                .set_input_type(InputType.recurrent(5)).build())
+        return MultiLayerNetwork(conf).init()
+
+    rng = np.random.default_rng(2)
+    x = rng.standard_normal((4, 16, 5)).astype(np.float32)
+    y = np.zeros((4, 16, 3), np.float32)
+    y[..., 1] = 1.0
+
+    net_h = build()
+    net_h.fit(x, y, batch_size=4, epochs=1, async_prefetch=False)
+    assert net_h.iteration == 2  # 2 TBPTT segments = 2 optimizer steps
+
+    set_helper_enabled("lstm_sequence", False)
+    try:
+        net_s = build()
+        net_s.fit(x, y, batch_size=4, epochs=1, async_prefetch=False)
+    finally:
+        set_helper_enabled("lstm_sequence", True)
+    for p1, p2 in zip(net_h.params_list, net_s.params_list):
+        for k in p1:
+            np.testing.assert_allclose(
+                np.asarray(p1[k]), np.asarray(p2[k]), rtol=2e-4, atol=2e-5,
+                err_msg=f"TBPTT param {k}")
