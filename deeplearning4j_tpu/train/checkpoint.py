@@ -114,6 +114,14 @@ class CheckpointListener(IterationListener):
             self._lock.release()
 
     def _gc(self):
+        # orphaned temp files from writers killed mid-save (their pid no
+        # longer matches a unique name any future writer reuses)
+        for f in os.listdir(self.dir):
+            if ".tmp" in f and f.startswith("checkpoint_iter"):
+                try:
+                    os.remove(os.path.join(self.dir, f))
+                except OSError:
+                    pass
         if self.keep_last <= 0:
             return
         ckpts = sorted(

@@ -89,9 +89,11 @@ class ProfilerListener(IterationListener):
         self.n = int(n_iterations)
         self.print_fn = print_fn or (lambda s: logger.info(s))
         self._active = False
+        self._last_iteration = -1
         self.summary: List[Tuple[str, float]] = []
 
     def iteration_done(self, model, iteration, info):
+        self._last_iteration = iteration
         if iteration == self.start and not self._active:
             jax.profiler.start_trace(self.log_dir)
             self._active = True
@@ -107,9 +109,11 @@ class ProfilerListener(IterationListener):
         # epoch boundary is finalized early, with a warning — place the
         # window inside one epoch for a full capture.
         if self._active:
-            logger.warning(
-                "profiler window truncated at epoch end (captured fewer "
-                "than n_iterations=%d steps)", self.n)
+            captured = self._last_iteration - self.start + 1
+            if captured < self.n:
+                logger.warning(
+                    "profiler window truncated at epoch end (captured "
+                    "%d of n_iterations=%d steps)", captured, self.n)
             if model._score is not None:  # complete the in-flight step
                 float(__import__("numpy").asarray(model._score))
             self._finalize()
