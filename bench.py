@@ -11,13 +11,25 @@ steady-state steps timed after a warmup fit that includes compilation;
 bf16 compute policy on TPU, f32 on CPU. The reference publishes no numbers
 (BASELINE.json published={}), so vs_baseline is null — an honest "no
 published baseline", not a self-graded 1.0.
+
+Wedge-proofing: the device tunnel on this box can wedge indefinitely (a
+bare backend touch hangs, no error). The orchestrator therefore never
+touches the jax backend itself; it runs (a) a watchdog probe subprocess
+(tiny matmul + scalar readback) under a hard deadline, then (b) each
+workload in its own subprocess with a per-workload timeout and an overall
+deadline. One hung workload costs its timeout, not the round: the
+headline JSON is always printed, with per-workload errors for whatever
+did not finish ("timeout", "rc=N ...", or "skipped: ...") and
+`infra_error: tunnel_wedged` when the probe itself never comes back.
 """
 
 import json
 import os
+import subprocess
+import sys
 import time
 
-import jax
+import jax  # import alone is safe; only backend *use* can wedge
 import numpy as np
 
 from deeplearning4j_tpu.data.dataset import DataSet
@@ -289,20 +301,117 @@ def bench_word2vec(vocab=10_000, n_sents=2_000, sent_len=40, batch=8192,
     }
 
 
+WORKLOADS = {
+    "resnet50": bench_resnet50,
+    "lenet": bench_lenet,
+    "char_lstm": bench_char_lstm,
+    "word2vec": bench_word2vec,
+    "vgg16_keras_import": bench_vgg16,
+}
+
+# Per-workload subprocess timeouts (seconds). First compile through the
+# tunnel is 20-40s; the big convnets get headroom for two compiles
+# (warmup shape + timed shape share one, but bf16 ResNet-50 compiles are
+# the slowest thing we run).
+TIMEOUTS = {
+    "resnet50": 600,
+    "lenet": 420,
+    "char_lstm": 600,
+    "word2vec": 600,
+    "vgg16_keras_import": 600,
+}
+PROBE_TIMEOUT = 120  # tiny matmul + readback; generous for backend init
+OVERALL_DEADLINE = float(os.environ.get("BENCH_DEADLINE_SEC", 1500))
+
+
+def _child_env():
+    env = dict(os.environ)
+    # Persistent compilation cache: repeated subprocess runs (and bench
+    # re-runs while tuning) skip recompiles of unchanged programs.
+    env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                   os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".jax_cache"))
+    return env
+
+
+def _run_child(args, timeout):
+    """Run `python bench.py <args>` with a hard timeout; return
+    (parsed-last-json-line | None, error | None)."""
+    cmd = [sys.executable, os.path.abspath(__file__)] + args
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=timeout, env=_child_env())
+    except subprocess.TimeoutExpired:
+        return None, "timeout"
+    if proc.returncode != 0:
+        tail = (proc.stderr or "").strip().splitlines()[-3:]
+        return None, f"rc={proc.returncode}: " + " | ".join(tail)[-400:]
+    for line in reversed((proc.stdout or "").strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line), None
+            except json.JSONDecodeError:
+                continue
+    return None, "no JSON on stdout"
+
+
+def _probe():
+    """Child mode: prove the device path is alive. Tiny matmul + scalar
+    readback (block_until_ready does not block through the tunnel)."""
+    import jax.numpy as jnp
+
+    x = jnp.ones((256, 256), jnp.float32)
+    y = x @ x
+    val = float(np.asarray(y[0, 0]))
+    print(json.dumps({
+        "ok": val == 256.0,
+        "backend": jax.default_backend(),
+        "device": jax.devices()[0].device_kind,
+    }))
+
+
+def _workload(name):
+    """Child mode: run one workload, print its JSON dict."""
+    out = WORKLOADS[name]()
+    out["backend"] = jax.default_backend()
+    print(json.dumps(out))
+
+
 def main():
-    workloads = {}
-    errors = {}
-    for name, fn in (
-        ("resnet50", bench_resnet50),
-        ("lenet", bench_lenet),
-        ("char_lstm", bench_char_lstm),
-        ("word2vec", bench_word2vec),
-        ("vgg16_keras_import", bench_vgg16),
-    ):
-        try:
-            workloads[name] = fn()
-        except Exception as e:  # keep the headline line printable
-            errors[name] = f"{type(e).__name__}: {e}"
+    t0 = time.time()
+    remaining = lambda: OVERALL_DEADLINE - (time.time() - t0)
+
+    workloads, errors = {}, {}
+    backend = device = None
+    infra_error = None
+
+    probe, perr = _run_child(["--probe"], min(PROBE_TIMEOUT, remaining()))
+    if probe is None:  # one retry: transient tunnel hiccups do recover
+        probe, perr = _run_child(["--probe"], min(PROBE_TIMEOUT, max(remaining(), 1)))
+    if probe is not None and not probe.get("ok"):
+        probe, perr = None, "probe computed a wrong matmul result"
+    if probe is None:
+        infra_error = ("tunnel_wedged" if perr == "timeout"
+                       else f"probe_failed: {perr}")
+        for name in WORKLOADS:
+            errors[name] = f"skipped: {infra_error}"
+    else:
+        backend, device = probe.get("backend"), probe.get("device")
+        for name in WORKLOADS:
+            budget = min(TIMEOUTS[name], remaining())
+            if budget < 60:
+                errors[name] = "skipped: overall deadline"
+                continue
+            out, err = _run_child(["--workload", name], budget)
+            if out is not None:
+                out.pop("backend", None)
+                workloads[name] = out
+                print(f"[bench] {name}: {json.dumps(out)}", file=sys.stderr)
+            else:
+                errors[name] = err
+                print(f"[bench] {name}: ERROR {err}", file=sys.stderr)
+
     head = workloads.get("resnet50", {})
     result = {
         "metric": "resnet50_train_images_per_sec_per_chip",
@@ -310,14 +419,28 @@ def main():
         "unit": head.get("unit", "images/sec/chip"),
         "vs_baseline": None,  # reference publishes no numbers (BASELINE.md)
         "mfu": head.get("mfu"),
-        "backend": jax.default_backend(),
-        "device": jax.devices()[0].device_kind,
+        "backend": backend,
+        "device": device,
         "workloads": workloads,
     }
     if errors:
         result["errors"] = errors
+    if infra_error:
+        result["infra_error"] = infra_error
     print(json.dumps(result))
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) > 1 and sys.argv[1] in ("--probe", "--workload"):
+        # The image's sitecustomize initializes the axon platform at
+        # interpreter start, which ignores JAX_PLATFORMS from the env; a
+        # config update before first backend *use* still wins.
+        plat = os.environ.get("JAX_PLATFORMS")
+        if plat:
+            jax.config.update("jax_platforms", plat)
+        if sys.argv[1] == "--probe":
+            _probe()
+        else:
+            _workload(sys.argv[2])
+    else:
+        main()

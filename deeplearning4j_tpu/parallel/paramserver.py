@@ -34,7 +34,9 @@ same tradeoff).
 from __future__ import annotations
 
 import json
+import logging
 import queue
+import struct
 import threading
 import urllib.request
 from typing import Dict, List, Optional
@@ -42,6 +44,61 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from deeplearning4j_tpu.utils.jsonhttp import JsonHttpServer, json_response
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+
+# -- binary wire format -------------------------------------------------------
+# A real [vocab, dim] f32 table pushed as JSON lists is ~10x the bytes and
+# far more CPU than raw rows; the hot routes (/pull.bin, /push.bin) move
+# raw little-endian buffers instead. JSON routes remain for debugging and
+# as the "transport is the pluggable part" demonstration.
+#
+#   request  := u16 name_len | name utf8 | u32 n_rows | u32 dim
+#               | i64 * n_rows row ids | f32 * n_rows * dim deltas
+#               (dim == 0 for pulls: no payload follows the ids)
+#   pull rsp := u32 n_rows | u32 dim | f32 * n_rows * dim raw rows
+
+def _pack_request(table: str, rows: np.ndarray,
+                  deltas: Optional[np.ndarray] = None) -> bytes:
+    name = table.encode()
+    rows = np.ascontiguousarray(rows, dtype="<i8")
+    if deltas is None:
+        head = struct.pack("<H", len(name)) + name + struct.pack(
+            "<II", rows.size, 0)
+        return head + rows.tobytes()
+    deltas = np.ascontiguousarray(deltas, dtype="<f4")
+    if deltas.ndim != 2 or deltas.shape[0] != rows.size:
+        raise ValueError(f"deltas must be [n_rows, dim], got {deltas.shape} "
+                         f"for {rows.size} rows")
+    head = struct.pack("<H", len(name)) + name + struct.pack(
+        "<II", rows.size, deltas.shape[1])
+    return head + rows.tobytes() + deltas.tobytes()
+
+
+def _unpack_request(body: bytes):
+    (name_len,) = struct.unpack_from("<H", body, 0)
+    name = body[2:2 + name_len].decode()
+    n, dim = struct.unpack_from("<II", body, 2 + name_len)
+    off = 2 + name_len + 8
+    rows = np.frombuffer(body, "<i8", count=n, offset=off)
+    off += 8 * n
+    deltas = None
+    if dim:
+        deltas = np.frombuffer(body, "<f4", count=n * dim,
+                               offset=off).reshape(n, dim)
+    return name, rows, deltas
+
+
+def _pack_rows(rows: np.ndarray) -> bytes:
+    rows = np.ascontiguousarray(rows, dtype="<f4")
+    n, dim = rows.shape
+    return struct.pack("<II", n, dim) + rows.tobytes()
+
+
+def _unpack_rows(body: bytes) -> np.ndarray:
+    n, dim = struct.unpack_from("<II", body, 0)
+    return np.frombuffer(body, "<f4", count=n * dim, offset=8).reshape(n, dim)
 
 
 class EmbeddingParameterServer:
@@ -72,6 +129,19 @@ class EmbeddingParameterServer:
     # -- http transport ------------------------------------------------------
 
     def _post(self, path, body, headers):
+        if path == "/pull.bin":
+            name, rows, _ = _unpack_request(body)
+            return 200, "application/octet-stream", _pack_rows(
+                self.pull(name, rows.tolist()))
+        if path == "/push.bin":
+            name, rows, deltas = _unpack_request(body)
+            self.push(name, rows.tolist(), deltas)
+            return 200, "application/octet-stream", b"ok"
+        if path == "/meta":
+            return json_response({
+                "tables": {k: list(v.shape) for k, v in self.tables.items()},
+                "pushes_applied": self.pushes_applied,
+            })
         req = json.loads(body)
         name = req["table"]
         rows = req["rows"]
@@ -92,12 +162,20 @@ class EmbeddingParameterServer:
 class EmbeddingPSClient:
     """Worker-side pull/push. Pushes ride a bounded background queue
     (fire-and-forget, the Aeron pushNDArray analog); pulls are
-    synchronous (the step needs the rows)."""
+    synchronous (the step needs the rows). The wire format is raw
+    little-endian rows (see _pack_request) — JSON would be ~10x the bytes
+    for real [vocab, dim] tables.
+
+    `dropped_pushes` counts push batches lost to dead/misbehaving
+    endpoints — training degrades (loses some async gradient mass)
+    rather than hanging, and the loss is observable instead of silent."""
 
     def __init__(self, urls: List[str], queue_size: int = 64,
                  timeout: float = 10.0):
         self.urls = [u.rstrip("/") for u in urls]
         self.timeout = timeout
+        self.dropped_pushes = 0
+        self._dims: Dict[str, int] = {}
         self._q: "queue.Queue" = queue.Queue(maxsize=queue_size)
         self._worker = threading.Thread(target=self._drain, daemon=True)
         self._worker.start()
@@ -105,31 +183,49 @@ class EmbeddingPSClient:
     def _owner(self, row: int) -> int:
         return row % len(self.urls)
 
-    def _post(self, url: str, route: str, body: dict) -> dict:
+    def _post_bin(self, url: str, route: str, payload: bytes) -> bytes:
         req = urllib.request.Request(
-            f"{url}{route}", data=json.dumps(body).encode(),
-            headers={"Content-Type": "application/json"})
+            f"{url}{route}", data=payload,
+            headers={"Content-Type": "application/octet-stream"})
         with urllib.request.urlopen(req, timeout=self.timeout) as r:
-            return json.loads(r.read())
+            return r.read()
+
+    def _dim(self, table: str) -> int:
+        """Table dim, cached from the first shard's /meta (needed to shape
+        empty pulls)."""
+        if table not in self._dims:
+            req = urllib.request.Request(self.urls[0] + "/meta", data=b"{}")
+            with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                meta = json.loads(r.read())
+            for k, shape in meta["tables"].items():
+                self._dims[k] = int(shape[1])
+        return self._dims[table]
 
     def pull(self, table: str, rows: np.ndarray) -> np.ndarray:
-        """Fetch rows (grouped per owning shard, order restored)."""
+        """Fetch rows (grouped per owning shard, order restored). Empty
+        row sets return a well-formed [0, dim] array."""
         rows = np.asarray(rows, np.int64)
+        if rows.size == 0:
+            return np.zeros((0, self._dim(table)), np.float32)
         out: Optional[np.ndarray] = None
         for s, url in enumerate(self.urls):
             sel = np.nonzero(rows % len(self.urls) == s)[0]
             if sel.size == 0:
                 continue
-            got = np.asarray(self._post(url, "/pull", {
-                "table": table, "rows": rows[sel].tolist()})["data"],
-                np.float32)
+            got = _unpack_rows(self._post_bin(
+                url, "/pull.bin", _pack_request(table, rows[sel])))
             if out is None:
                 out = np.zeros((rows.size, got.shape[1]), np.float32)
             out[sel] = got
+        self._dims.setdefault(table, int(out.shape[1]))
         return out
 
     def push_async(self, table: str, rows: np.ndarray,
                    deltas: np.ndarray) -> None:
+        deltas = np.asarray(deltas, np.float32)
+        if deltas.ndim != 2 or deltas.shape[0] != np.asarray(rows).size:
+            raise ValueError(  # fail at the call site, not in the drain
+                f"deltas must be [n_rows, dim], got {deltas.shape}")
         try:
             self._q.put_nowait((table, np.asarray(rows, np.int64),
                                 np.asarray(deltas, np.float32)))
@@ -146,11 +242,16 @@ class EmbeddingPSClient:
                     sel = np.nonzero(rows % len(self.urls) == s)[0]
                     if sel.size == 0:
                         continue
-                    self._post(url, "/push", {
-                        "table": table, "rows": rows[sel].tolist(),
-                        "deltas": deltas[sel].tolist()})
-            except OSError:
-                pass  # endpoint down: drop this push, keep training
+                    self._post_bin(url, "/push.bin",
+                                   _pack_request(table, rows[sel],
+                                                 deltas[sel]))
+            except Exception as e:
+                # endpoint down or reply malformed: drop THIS push and keep
+                # the drain thread alive — a dead thread would silently
+                # wedge push_async once the bounded queue fills
+                self.dropped_pushes += 1
+                logger.warning("PS push dropped (%d total): %s",
+                               self.dropped_pushes, e)
             finally:
                 self._q.task_done()
 

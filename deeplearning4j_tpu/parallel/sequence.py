@@ -34,20 +34,28 @@ def _block_attend(q, k, v, *, scale, causal, q_start, kv_start):
     stats. q: [B, Tq, H, D]; k/v: [B, Tk, H, D]. Returns (m, l, o):
     running max [B, H, Tq], sum-exp [B, H, Tq], weighted values
     [B, Tq, H, D]."""
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    # Softmax statistics live in at-least-f32 (flash convention): the
+    # QK^T and PV dots keep bf16 operands on the MXU but accumulate f32
+    # via preferred_element_type, so bf16 long-context inputs never
+    # accumulate softmax mass in bf16 across ring hops. f64 inputs (the
+    # gradient-check harness) keep full f64 statistics.
+    acc_dt = jnp.promote_types(q.dtype, jnp.float32)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=acc_dt) * scale
     if causal:
         Tq, Tk = q.shape[1], k.shape[1]
         qpos = q_start + jnp.arange(Tq)[:, None]
         kpos = kv_start + jnp.arange(Tk)[None, :]
         s = jnp.where(qpos >= kpos, s, -jnp.inf)
-    m = jnp.max(s, axis=-1)                          # [B, H, Tq]
+    m = jnp.max(s, axis=-1)                          # [B, H, Tq] f32
     # fully-masked rows (causal, kv block entirely in the future) produce
     # -inf max; exp(-inf - -inf) would be NaN — clamp those rows
     m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
     p = jnp.exp(s - m_safe[..., None])
     p = jnp.where(jnp.isfinite(s), p, 0.0)
-    l = jnp.sum(p, axis=-1)                          # [B, H, Tq]
-    o = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    l = jnp.sum(p, axis=-1)                          # [B, H, Tq] f32
+    o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+                   preferred_element_type=acc_dt)
     return m_safe, l, o
 
 
@@ -76,18 +84,23 @@ def ring_attention_sharded(q, k, v, *, axis_name: str = SEQ_AXIS,
     p = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
     t_local = q.shape[1]
-    scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype)
+    acc_dt = jnp.promote_types(q.dtype, jnp.float32)
+    scale = jnp.sqrt(jnp.asarray(q.shape[-1], acc_dt)) ** -1
     q_start = idx * t_local
 
     B, T, H, D = q.shape
+    # accumulators are at-least-f32 regardless of q.dtype — see _block_attend
     acc = (
-        jnp.full((B, H, T), -jnp.inf, q.dtype),
-        jnp.zeros((B, H, T), q.dtype),
-        jnp.zeros((B, T, H, D), q.dtype),
+        jnp.full((B, H, T), -jnp.inf, acc_dt),
+        jnp.zeros((B, H, T), acc_dt),
+        jnp.zeros((B, T, H, D), acc_dt),
     )
     # the accumulator becomes device-varying after the first hop; mark the
     # (device-constant) init accordingly for shard_map's axis typing
-    if hasattr(lax, "pvary"):
+    if hasattr(lax, "pcast"):
+        acc = jax.tree_util.tree_map(
+            lambda a: lax.pcast(a, (axis_name,), to="varying"), acc)
+    elif hasattr(lax, "pvary"):  # pre-0.9 jax
         acc = jax.tree_util.tree_map(
             lambda a: lax.pvary(a, (axis_name,)), acc)
     # static unroll over the (small, known) ring size: lets XLA overlap
@@ -105,20 +118,25 @@ def ring_attention_sharded(q, k, v, *, axis_name: str = SEQ_AXIS,
             v_cur = lax.ppermute(v_cur, axis_name, perm)
     m, l, o = acc
     l = jnp.maximum(l, 1e-20)
-    return o / jnp.moveaxis(l, 1, -1)[..., None]
+    out = o / jnp.moveaxis(l, 1, -1)[..., None]
+    return out.astype(q.dtype)
 
 
 def full_attention(q, k, v, *, causal: bool = False):
     """Single-device reference: ordinary softmax attention
     ([B, T, H, D] inputs, head-batched)."""
-    scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype)
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    acc_dt = jnp.promote_types(q.dtype, jnp.float32)
+    scale = jnp.sqrt(jnp.asarray(q.shape[-1], acc_dt)) ** -1
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=acc_dt) * scale
     if causal:
         T = q.shape[1]
         mask = jnp.tril(jnp.ones((T, T), bool))
         s = jnp.where(mask, s, -jnp.inf)
     a = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bhqk,bkhd->bqhd", a, v)
+    out = jnp.einsum("bhqk,bkhd->bqhd", a.astype(v.dtype), v,
+                     preferred_element_type=acc_dt)
+    return out.astype(q.dtype)
 
 
 def ring_self_attention(x, wq, wk, wv, wo, *, mesh: Mesh,
@@ -141,7 +159,7 @@ def ring_self_attention(x, wq, wk, wv, wo, *, mesh: Mesh,
                                    causal=causal)
         return o.reshape(B, Tl, E) @ wo
 
-    from jax.experimental.shard_map import shard_map
+    shard_map = jax.shard_map
 
     spec_x = PartitionSpec(None, axis_name, None)
     return shard_map(

@@ -114,12 +114,30 @@ class CheckpointListener(IterationListener):
             self._lock.release()
 
     def _gc(self):
-        # orphaned temp files from writers killed mid-save (their pid no
-        # longer matches a unique name any future writer reuses)
+        # orphaned temp files from writers killed mid-save. A tmp file is
+        # only an orphan if its embedded pid is not a live process (several
+        # hosts may share the dir) AND it hasn't been touched recently —
+        # deleting a peer's in-flight write would corrupt its save.
+        now = time.time()
         for f in os.listdir(self.dir):
             if ".tmp" in f and f.startswith("checkpoint_iter"):
+                path = os.path.join(self.dir, f)
                 try:
-                    os.remove(os.path.join(self.dir, f))
+                    pid = int(f.split(".")[-3])
+                except (ValueError, IndexError):
+                    pid = None
+                if pid is not None and pid != os.getpid():
+                    try:
+                        os.kill(pid, 0)  # 0 = existence probe, no signal
+                        continue  # writer is alive: leave its tmp alone
+                    except ProcessLookupError:
+                        pass  # dead pid: orphan
+                    except OSError:
+                        continue  # EPERM etc: play safe, keep the file
+                try:
+                    if now - os.path.getmtime(path) < 300:
+                        continue  # written moments ago: grace window
+                    os.remove(path)
                 except OSError:
                     pass
         if self.keep_last <= 0:

@@ -31,6 +31,11 @@ jax.config.update("jax_default_matmul_precision", "highest")
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running tests (multi-process, large fits)")
+
+
 @pytest.fixture
 def rng_key():
     import jax

@@ -71,3 +71,72 @@ def test_two_workers_async_sgd_converges():
     err = float(np.mean((final - target) ** 2))
     assert err < 0.02, err
     assert server.pushes_applied > 100
+
+
+def test_empty_pull_returns_well_formed_array():
+    server = EmbeddingParameterServer(
+        {"syn0": np.zeros((6, 5), np.float32)})
+    port = server.start()
+    try:
+        client = EmbeddingPSClient([f"http://127.0.0.1:{port}"])
+        out = client.pull("syn0", np.array([], np.int64))
+        assert out.shape == (0, 5) and out.dtype == np.float32
+    finally:
+        server.stop()
+
+
+def test_dead_endpoint_drops_push_and_counts_it():
+    """A dead shard must not kill the drain thread (which would wedge
+    push_async once the queue fills) — the push is dropped, counted, and
+    later pushes to live endpoints still apply."""
+    server = EmbeddingParameterServer({"syn0": np.zeros((4, 3), np.float32)})
+    port = server.start()
+    try:
+        # two "shards": the second URL is a closed port
+        client = EmbeddingPSClient(
+            [f"http://127.0.0.1:{port}", "http://127.0.0.1:1"],
+            timeout=2.0)
+        rows = np.array([1, 3])  # odd rows -> owner 1 (the dead one)
+        client.push_async("syn0", rows, np.ones((2, 3), np.float32))
+        client.flush()
+        assert client.dropped_pushes == 1
+        # drain thread is still alive: a push owned by the live shard lands
+        client.push_async("syn0", np.array([0, 2]),
+                          np.ones((2, 3), np.float32))
+        client.flush()
+        assert server.tables["syn0"][0, 0] == 1.0
+        assert server.tables["syn0"][2, 0] == 1.0
+    finally:
+        server.stop()
+
+
+def test_binary_payload_throughput():
+    """The hot path is raw bytes, not JSON — measure pushes/sec for a
+    realistic [1024, 128] f32 row batch and assert a sane floor (the old
+    JSON path measured ~10x slower at this size)."""
+    import time
+
+    dim, n_rows, n_pushes = 128, 1024, 50
+    server = EmbeddingParameterServer(
+        {"syn0": np.zeros((65536, dim), np.float32)})
+    port = server.start()
+    try:
+        client = EmbeddingPSClient([f"http://127.0.0.1:{port}"],
+                                   queue_size=8)
+        rng = np.random.default_rng(0)
+        rows = rng.choice(65536, size=n_rows, replace=False)
+        deltas = rng.standard_normal((n_rows, dim)).astype(np.float32)
+        client.push_async("syn0", rows, deltas)  # warm the connection
+        client.flush()
+        t0 = time.perf_counter()
+        for _ in range(n_pushes):
+            client.push_async("syn0", rows, deltas)
+        client.flush()
+        dt = time.perf_counter() - t0
+        rate = n_pushes / dt
+        mb_s = n_pushes * deltas.nbytes / dt / 1e6
+        print(f"PS binary push rate: {rate:.0f}/s ({mb_s:.0f} MB/s)")
+        assert client.dropped_pushes == 0
+        assert rate > 20, rate  # raw-bytes floor; JSON path was ~an order under
+    finally:
+        server.stop()
