@@ -405,7 +405,15 @@ def main():
                 continue
             out, err = _run_child(["--workload", name], budget)
             if out is not None:
-                out.pop("backend", None)
+                child_backend = out.pop("backend", None)
+                if child_backend != backend:
+                    # a child that silently fell back (e.g. tunnel dropped
+                    # after the probe) must not pass off CPU numbers
+                    errors[name] = (f"backend mismatch: child ran on "
+                                    f"{child_backend}, probe saw {backend}")
+                    print(f"[bench] {name}: ERROR {errors[name]}",
+                          file=sys.stderr)
+                    continue
                 workloads[name] = out
                 print(f"[bench] {name}: {json.dumps(out)}", file=sys.stderr)
             else:

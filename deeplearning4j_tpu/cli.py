@@ -33,10 +33,17 @@ def guess_and_load_model(path: str):
     # both formats carry configuration.json + coefficients.bin; only this
     # framework's zips have meta.json (utils/model_serializer)
     if "coefficients.bin" in names and "meta.json" not in names:
+        import json
+
         from deeplearning4j_tpu.modelimport.dl4j import (
+            import_dl4j_computation_graph,
             import_dl4j_multilayer,
         )
 
+        with zipfile.ZipFile(path) as zf:
+            conf = json.loads(zf.read("configuration.json"))
+        if "networkInputs" in conf:  # ComputationGraphConfiguration
+            return import_dl4j_computation_graph(path)
         return import_dl4j_multilayer(path)
     from deeplearning4j_tpu.utils.model_serializer import load_model
 

@@ -160,6 +160,8 @@ def _map_layer(name: str, lj: dict):
         return L.BatchNormalization(
             n_in=n_in or None, eps=lj.get("eps", 1e-5),
             decay=lj.get("decay", 0.9),
+            lock_gamma_beta=bool(lj.get("lockGammaBeta", False)),
+            gamma=lj.get("gamma", 1.0), beta=lj.get("beta", 0.0),
         )
     if name in ("LSTM", "gravesLSTM"):
         cls = L.LSTM if name == "LSTM" else L.GravesLSTM
@@ -188,6 +190,64 @@ def _perm_ifog(cols: np.ndarray, H: int) -> np.ndarray:
     return np.concatenate([G, F, I, O], axis=-1)
 
 
+# -- shared flat-buffer walk -------------------------------------------------
+
+def _consume_layer_params(take, tag: str, lc, p: dict, lj: dict, state):
+    """Consume one layer's slice of the DL4J flat buffer into this
+    framework's param dict `p` (and BN running stats into `state`).
+    Layouts per nn/params/* (module docstring). Returns the state dict
+    (possibly replaced) for the caller to store back."""
+    if tag in ("dense", "output", "rnnoutput", "embedding"):
+        n_in, n_out = int(lc.n_in), int(lc.n_out)
+        W = take(n_in * n_out).reshape((n_in, n_out), order="F")
+        b = take(n_out)
+        p["W"] = p["W"].at[:].set(W)
+        p["b"] = p["b"].at[:].set(b)
+    elif tag == "convolution":
+        kh, kw = (int(k) for k in lc.kernel_size)
+        n_in, n_out = int(lc.n_in), int(lc.n_out)
+        W = take(n_out * n_in * kh * kw).reshape(
+            (n_out, n_in, kh, kw), order="F")
+        p["W"] = p["W"].at[:].set(W.transpose(2, 3, 1, 0))  # -> HWIO
+        p["b"] = p["b"].at[:].set(take(n_out))
+    elif tag == "batchNormalization":
+        n = int(lc.n_in)
+        if lj.get("lockGammaBeta", False):
+            # BatchNormalizationParamInitializer stores only mean/var when
+            # gamma/beta are locked; the fixed values come from the conf
+            p["gamma"] = p["gamma"].at[:].set(
+                np.full(n, lj.get("gamma", 1.0), np.float32))
+            p["beta"] = p["beta"].at[:].set(
+                np.full(n, lj.get("beta", 0.0), np.float32))
+        else:
+            p["gamma"] = p["gamma"].at[:].set(take(n))
+            p["beta"] = p["beta"].at[:].set(take(n))
+        mean, var = take(n), take(n)
+        st = dict(state or {})
+        st["mean"] = st["mean"].at[:].set(mean)
+        st["var"] = st["var"].at[:].set(var)
+        return st
+    elif tag in ("LSTM", "gravesLSTM"):
+        n_in, H = int(lc.n_in), int(lc.n_out)
+        W = take(n_in * 4 * H).reshape((n_in, 4 * H), order="F")
+        rw_cols = 4 * H + (3 if tag == "gravesLSTM" else 0)
+        RW_full = take(H * rw_cols).reshape((H, rw_cols), order="F")
+        b = take(4 * H)
+        p["W"] = p["W"].at[:].set(_perm_ifog(W, H))
+        p["RW"] = p["RW"].at[:].set(_perm_ifog(RW_full[:, :4 * H], H))
+        p["b"] = p["b"].at[:].set(_perm_ifog(b[None, :], H)[0])
+        if tag == "gravesLSTM":
+            # peephole columns [wFF, wOO, wGG] (LSTMHelpers.java:104)
+            p["pF"] = p["pF"].at[:].set(RW_full[:, 4 * H])
+            p["pO"] = p["pO"].at[:].set(RW_full[:, 4 * H + 1])
+            p["pI"] = p["pI"].at[:].set(RW_full[:, 4 * H + 2])
+    elif tag in ("activation", "dropout", "subsampling", "globalPooling"):
+        pass  # no params
+    else:
+        raise ValueError(f"no flat layout for layer tag {tag!r}")
+    return state
+
+
 # -- the importer ------------------------------------------------------------
 
 def import_dl4j_multilayer(path: str, precision: str = "f32"):
@@ -205,6 +265,7 @@ def import_dl4j_multilayer(path: str, precision: str = "f32"):
     confs = conf_json.get("confs", [])
     layers: List = []
     tags: List[str] = []
+    bodies: List[dict] = []
     for c in confs:
         lj = c.get("layer", {})
         if not lj:
@@ -212,6 +273,7 @@ def import_dl4j_multilayer(path: str, precision: str = "f32"):
         (tag, body), = lj.items()
         layers.append(_map_layer(tag, body))
         tags.append(tag)
+        bodies.append(body)
 
     builder = NeuralNetConfiguration.builder().precision(precision).list()
     for l in layers:
@@ -238,48 +300,9 @@ def import_dl4j_multilayer(path: str, precision: str = "f32"):
         off += n
         return out
 
-    for i, (tag, lc) in enumerate(zip(tags, layers)):
-        p = net.params_list[i]
-        if tag in ("dense", "output", "rnnoutput", "embedding"):
-            n_in, n_out = int(lc.n_in), int(lc.n_out)
-            W = take(n_in * n_out).reshape((n_in, n_out), order="F")
-            b = take(n_out)
-            p["W"] = p["W"].at[:].set(W)
-            p["b"] = p["b"].at[:].set(b)
-        elif tag == "convolution":
-            kh, kw = (int(k) for k in lc.kernel_size)
-            n_in, n_out = int(lc.n_in), int(lc.n_out)
-            W = take(n_out * n_in * kh * kw).reshape(
-                (n_out, n_in, kh, kw), order="F")
-            p["W"] = p["W"].at[:].set(W.transpose(2, 3, 1, 0))  # -> HWIO
-            p["b"] = p["b"].at[:].set(take(n_out))
-        elif tag == "batchNormalization":
-            n = int(lc.n_in)
-            p["gamma"] = p["gamma"].at[:].set(take(n))
-            p["beta"] = p["beta"].at[:].set(take(n))
-            mean, var = take(n), take(n)
-            st = dict(net.state_list[i] or {})
-            st["mean"] = st["mean"].at[:].set(mean)
-            st["var"] = st["var"].at[:].set(var)
-            net.state_list[i] = st
-        elif tag in ("LSTM", "gravesLSTM"):
-            n_in, H = int(lc.n_in), int(lc.n_out)
-            W = take(n_in * 4 * H).reshape((n_in, 4 * H), order="F")
-            rw_cols = 4 * H + (3 if tag == "gravesLSTM" else 0)
-            RW_full = take(H * rw_cols).reshape((H, rw_cols), order="F")
-            b = take(4 * H)
-            p["W"] = p["W"].at[:].set(_perm_ifog(W, H))
-            p["RW"] = p["RW"].at[:].set(_perm_ifog(RW_full[:, :4 * H], H))
-            p["b"] = p["b"].at[:].set(_perm_ifog(b[None, :], H)[0])
-            if tag == "gravesLSTM":
-                # peephole columns [wFF, wOO, wGG] (LSTMHelpers.java:104)
-                p["pF"] = p["pF"].at[:].set(RW_full[:, 4 * H])
-                p["pO"] = p["pO"].at[:].set(RW_full[:, 4 * H + 1])
-                p["pI"] = p["pI"].at[:].set(RW_full[:, 4 * H + 2])
-        elif tag in ("activation", "dropout", "subsampling", "globalPooling"):
-            pass  # no params
-        else:
-            raise ValueError(f"no flat layout for layer tag {tag!r}")
+    for i, (tag, lc, lj) in enumerate(zip(tags, layers, bodies)):
+        net.state_list[i] = _consume_layer_params(
+            take, tag, lc, net.params_list[i], lj, net.state_list[i])
     if off != flat.size:
         raise ValueError(
             f"coefficients.bin length mismatch: consumed {off} of {flat.size}")
@@ -287,6 +310,95 @@ def import_dl4j_multilayer(path: str, precision: str = "f32"):
 
 
 # -- fixture/export writer ---------------------------------------------------
+
+def _export_layer(lc, p: dict, st) -> Tuple[str, dict, List[np.ndarray]]:
+    """One layer conf + params (+ BN state) -> (DL4J tag, layer-conf JSON
+    body, flat parts in the reference layouts)."""
+    flat_parts: List[np.ndarray] = []
+    if isinstance(lc, L.ConvolutionLayer):
+        tag = "convolution"
+        body = {
+            "nin": int(lc.n_in), "nout": int(lc.n_out),
+            "activationFn": lc.activation,
+            "kernelSize": list(lc.kernel_size),
+            "stride": list(lc.stride), "padding": list(lc.padding),
+            "convolutionMode":
+                "Same" if str(lc.convolution_mode).endswith("same")
+                else "Truncate",
+        }
+        W = p["W"].transpose(3, 2, 0, 1)  # HWIO -> [nOut,nIn,kh,kw]
+        flat_parts += [W.reshape(-1, order="F"), p["b"].reshape(-1)]
+    elif isinstance(lc, L.BatchNormalization):
+        tag = "batchNormalization"
+        body = {"nin": int(lc.n_in), "nout": int(lc.n_in),
+                "eps": lc.eps, "decay": lc.decay}
+        st = st or {}
+        if lc.lock_gamma_beta:
+            body["lockGammaBeta"] = True
+            body["gamma"], body["beta"] = lc.gamma, lc.beta
+        else:
+            flat_parts += [p["gamma"], p["beta"]]
+        flat_parts += [np.asarray(st.get("mean")), np.asarray(st.get("var"))]
+    elif isinstance(lc, (L.LSTM, L.GravesLSTM)):
+        graves = isinstance(lc, L.GravesLSTM)
+        tag = "gravesLSTM" if graves else "LSTM"
+        H = int(lc.n_out)
+        body = {"nin": int(lc.n_in), "nout": H,
+                "activationFn": lc.activation,
+                "gateActivationFn": lc.gate_activation,
+                "forgetGateBiasInit": lc.forget_gate_bias_init}
+        inv = lambda cols: np.concatenate(
+            [cols[..., 2 * H:3 * H],           # I <- my g (candidate)
+             cols[..., H:2 * H],               # F <- my f
+             cols[..., 3 * H:],                # O <- my o
+             cols[..., :H]], axis=-1)          # G <- my i (input gate)
+        RW = inv(p["RW"])
+        if graves:
+            RW = np.concatenate(
+                [RW, p["pF"][:, None], p["pO"][:, None],
+                 p["pI"][:, None]], axis=1)
+        flat_parts += [inv(p["W"]).reshape(-1, order="F"),
+                       RW.reshape(-1, order="F"),
+                       inv(p["b"][None, :])[0]]
+    elif isinstance(lc, L.OutputLayer):
+        tag = "output"
+        body = {"nin": int(lc.n_in), "nout": int(lc.n_out),
+                "activationFn": lc.activation, "lossFn": lc.loss}
+        flat_parts += [p["W"].reshape(-1, order="F"), p["b"].reshape(-1)]
+    elif isinstance(lc, L.RnnOutputLayer):
+        tag = "rnnoutput"
+        body = {"nin": int(lc.n_in), "nout": int(lc.n_out),
+                "activationFn": lc.activation, "lossFn": lc.loss}
+        flat_parts += [p["W"].reshape(-1, order="F"), p["b"].reshape(-1)]
+    elif isinstance(lc, L.DenseLayer):
+        tag = "dense"
+        body = {"nin": int(lc.n_in), "nout": int(lc.n_out),
+                "activationFn": lc.activation}
+        flat_parts += [p["W"].reshape(-1, order="F"), p["b"].reshape(-1)]
+    elif isinstance(lc, L.EmbeddingLayer):
+        tag = "embedding"
+        body = {"nin": int(lc.n_in), "nout": int(lc.n_out),
+                "activationFn": lc.activation}
+        flat_parts += [p["W"].reshape(-1, order="F"), p["b"].reshape(-1)]
+    elif isinstance(lc, L.ActivationLayer):
+        tag, body = "activation", {"activationFn": lc.activation}
+    elif isinstance(lc, L.SubsamplingLayer):
+        tag = "subsampling"
+        body = {"poolingType": str(lc.pooling_type).upper(),
+                "kernelSize": list(lc.kernel_size),
+                "stride": list(lc.stride), "padding": list(lc.padding),
+                "convolutionMode":
+                    "Same" if str(lc.convolution_mode).endswith("same")
+                    else "Truncate"}
+    elif isinstance(lc, L.GlobalPoolingLayer):
+        tag = "globalPooling"
+        body = {"poolingType": str(lc.pooling_type).upper()}
+    elif isinstance(lc, L.DropoutLayer):
+        tag, body = "dropout", {"dropOut": lc.dropout}
+    else:
+        raise ValueError(f"cannot export layer {type(lc).__name__}")
+    return tag, body, flat_parts
+
 
 def export_dl4j_zip(net, path: str) -> None:
     """Write a network in the reference zip format (the inverse mapping of
@@ -296,81 +408,9 @@ def export_dl4j_zip(net, path: str) -> None:
     flat_parts: List[np.ndarray] = []
     for i, lc in enumerate(net.layer_confs):
         p = {k: np.asarray(v) for k, v in net.params_list[i].items()}
-        if isinstance(lc, L.ConvolutionLayer):
-            tag = "convolution"
-            body = {
-                "nin": int(lc.n_in), "nout": int(lc.n_out),
-                "activationFn": lc.activation,
-                "kernelSize": list(lc.kernel_size),
-                "stride": list(lc.stride), "padding": list(lc.padding),
-                "convolutionMode":
-                    "Same" if str(lc.convolution_mode).endswith("same")
-                    else "Truncate",
-            }
-            W = p["W"].transpose(3, 2, 0, 1)  # HWIO -> [nOut,nIn,kh,kw]
-            flat_parts += [W.reshape(-1, order="F"), p["b"].reshape(-1)]
-        elif isinstance(lc, L.BatchNormalization):
-            tag = "batchNormalization"
-            body = {"nin": int(lc.n_in), "nout": int(lc.n_in),
-                    "eps": lc.eps, "decay": lc.decay}
-            st = net.state_list[i] or {}
-            flat_parts += [p["gamma"], p["beta"],
-                           np.asarray(st.get("mean")),
-                           np.asarray(st.get("var"))]
-        elif isinstance(lc, (L.LSTM, L.GravesLSTM)):
-            graves = isinstance(lc, L.GravesLSTM)
-            tag = "gravesLSTM" if graves else "LSTM"
-            H = int(lc.n_out)
-            body = {"nin": int(lc.n_in), "nout": H,
-                    "activationFn": lc.activation,
-                    "gateActivationFn": lc.gate_activation,
-                    "forgetGateBiasInit": lc.forget_gate_bias_init}
-            inv = lambda cols: np.concatenate(
-                [cols[..., 2 * H:3 * H],           # I <- my g (candidate)
-                 cols[..., H:2 * H],               # F <- my f
-                 cols[..., 3 * H:],                # O <- my o
-                 cols[..., :H]], axis=-1)          # G <- my i (input gate)
-            RW = inv(p["RW"])
-            if graves:
-                RW = np.concatenate(
-                    [RW, p["pF"][:, None], p["pO"][:, None],
-                     p["pI"][:, None]], axis=1)
-            flat_parts += [inv(p["W"]).reshape(-1, order="F"),
-                           RW.reshape(-1, order="F"),
-                           inv(p["b"][None, :])[0]]
-        elif isinstance(lc, L.OutputLayer):
-            tag = "output"
-            body = {"nin": int(lc.n_in), "nout": int(lc.n_out),
-                    "activationFn": lc.activation, "lossFn": lc.loss}
-            flat_parts += [p["W"].reshape(-1, order="F"), p["b"].reshape(-1)]
-        elif isinstance(lc, L.RnnOutputLayer):
-            tag = "rnnoutput"
-            body = {"nin": int(lc.n_in), "nout": int(lc.n_out),
-                    "activationFn": lc.activation, "lossFn": lc.loss}
-            flat_parts += [p["W"].reshape(-1, order="F"), p["b"].reshape(-1)]
-        elif isinstance(lc, L.DenseLayer):
-            tag = "dense"
-            body = {"nin": int(lc.n_in), "nout": int(lc.n_out),
-                    "activationFn": lc.activation}
-            flat_parts += [p["W"].reshape(-1, order="F"), p["b"].reshape(-1)]
-        elif isinstance(lc, L.EmbeddingLayer):
-            tag = "embedding"
-            body = {"nin": int(lc.n_in), "nout": int(lc.n_out),
-                    "activationFn": lc.activation}
-            flat_parts += [p["W"].reshape(-1, order="F"), p["b"].reshape(-1)]
-        elif isinstance(lc, L.ActivationLayer):
-            tag, body = "activation", {"activationFn": lc.activation}
-        elif isinstance(lc, L.SubsamplingLayer):
-            tag = "subsampling"
-            body = {"poolingType": str(lc.pooling_type).upper(),
-                    "kernelSize": list(lc.kernel_size),
-                    "stride": list(lc.stride), "padding": list(lc.padding),
-                    "convolutionMode":
-                        "Same" if str(lc.convolution_mode).endswith("same")
-                        else "Truncate"}
-        else:
-            raise ValueError(f"cannot export layer {type(lc).__name__}")
+        tag, body, parts = _export_layer(lc, p, net.state_list[i])
         conf_out["confs"].append({"layer": {tag: body}})
+        flat_parts += parts
 
     flat = (np.concatenate([f.astype(np.float32).reshape(-1)
                             for f in flat_parts])
@@ -380,3 +420,235 @@ def export_dl4j_zip(net, path: str) -> None:
     with zipfile.ZipFile(path, "w") as zf:
         zf.writestr("configuration.json", json.dumps(conf_out))
         zf.writestr("coefficients.bin", buf.getvalue())
+
+
+# -- ComputationGraph zips ----------------------------------------------------
+# Reference format (ModelSerializer.java:228 restoreComputationGraph): the
+# same zip layout, but configuration.json is a ComputationGraphConfiguration
+# — networkInputs / networkOutputs / vertices (LinkedHashMap, JSON order =
+# builder order) / vertexInputs — and coefficients.bin concatenates each
+# parameterized vertex's flat view in TOPOLOGICAL order
+# (ComputationGraph.java:365-402: vertex numbers are inputs-then-JSON-order;
+# the flat walk follows topologicalSortOrder(), Kahn's algorithm with a FIFO
+# queue whose ties resolve in ascending vertex number — Java HashMap/HashSet
+# over small int keys iterate ascending).
+
+def _dl4j_topo_names(inputs: List[str], vertex_names: List[str],
+                     vertex_inputs: dict) -> List[str]:
+    """The reference's exact topological ordering over vertex NAMES."""
+    names = list(inputs) + list(vertex_names)
+    idx = {n: i for i, n in enumerate(names)}
+    indeg = {i: 0 for i in range(len(names))}
+    outs = {i: set() for i in range(len(names))}
+    for name, ins in vertex_inputs.items():
+        j = idx[name]
+        for src in ins:
+            outs[idx[src]].add(j)
+            indeg[j] += 1
+    queue = [i for i in sorted(indeg) if indeg[i] == 0]
+    order: List[int] = []
+    while queue:
+        nxt = queue.pop(0)
+        order.append(nxt)
+        for j in sorted(outs[nxt]):  # ascending, like HashSet<int> iteration
+            indeg[j] -= 1
+            if indeg[j] == 0:
+                queue.append(j)
+    if len(order) != len(names):
+        raise ValueError("cycle in imported graph configuration")
+    return [names[i] for i in order]
+
+
+def _map_vertex(tag: str, body: dict):
+    """DL4J graph-vertex JSON -> this framework's vertex conf (non-layer
+    types; LayerVertex is handled by the importer)."""
+    from deeplearning4j_tpu.nn.conf import graph as G
+
+    if tag == "MergeVertex":
+        return G.MergeVertex()
+    if tag == "ElementWiseVertex":
+        return G.ElementWiseVertex(op=str(body.get("op", "Add")).lower())
+    if tag == "SubsetVertex":
+        return G.SubsetVertex(from_=int(body["from"]), to=int(body["to"]))
+    if tag == "StackVertex":
+        return G.StackVertex()
+    if tag == "UnstackVertex":
+        return G.UnstackVertex(from_=int(body["from"]),
+                               stack_size=int(body["stackSize"]))
+    if tag == "ScaleVertex":
+        return G.ScaleVertex(scale=float(body["scaleFactor"]))
+    if tag == "ShiftVertex":
+        return G.ShiftVertex(shift=float(body.get("shiftFactor", 0.0)))
+    if tag == "L2Vertex":
+        return G.L2Vertex()
+    if tag == "L2NormalizeVertex":
+        return G.L2NormalizeVertex()
+    if tag == "LastTimeStepVertex":
+        return G.LastTimeStepVertex(mask_input=body.get("maskArrayInputName"))
+    if tag == "DuplicateToTimeSeriesVertex":
+        return G.DuplicateToTimeSeriesVertex(ref_input=body.get("inputName"))
+    raise ValueError(f"unsupported DL4J graph vertex type {tag!r} for import")
+
+
+def import_dl4j_computation_graph(path: str, precision: str = "f32"):
+    """Load a reference-format ComputationGraph zip
+    (ModelSerializer.java:228 restoreComputationGraph) into a
+    ComputationGraph with parameters and BN stats restored."""
+    from deeplearning4j_tpu.nn.compgraph import ComputationGraph
+    from deeplearning4j_tpu.nn.conf import graph as G
+
+    with zipfile.ZipFile(path) as zf:
+        cj = json.loads(zf.read("configuration.json"))
+        flat = read_nd4j_array(io.BytesIO(zf.read("coefficients.bin")))
+    flat = np.asarray(flat).reshape(-1)
+
+    inputs = list(cj["networkInputs"])
+    outputs = list(cj["networkOutputs"])
+    vertices_json = cj.get("vertices", {})  # JSON order == builder order
+    vertex_inputs = {k: list(v) for k, v in cj.get("vertexInputs", {}).items()}
+
+    layer_confs = {}   # name -> (tag, our layer conf, raw body)
+    vertex_confs = {}  # name -> our vertex conf
+    for name, vj in vertices_json.items():
+        (vtag, vbody), = vj.items()
+        if vtag == "LayerVertex":
+            lj = vbody.get("layerConf", {}).get("layer", {})
+            if not lj:
+                raise ValueError(f"LayerVertex {name!r} without layer conf")
+            (ltag, lbody), = lj.items()
+            layer_confs[name] = (ltag, _map_layer(ltag, lbody), lbody)
+        else:
+            vertex_confs[name] = _map_vertex(vtag, vbody)
+
+    topo = _dl4j_topo_names(inputs, list(vertices_json), vertex_inputs)
+
+    builder = (NeuralNetConfiguration.builder().precision(precision)
+               .graph_builder().add_inputs(*inputs))
+    for name in topo:  # topo order satisfies inputs-before-use
+        if name in inputs:
+            continue
+        ins = vertex_inputs[name]
+        if name in layer_confs:
+            builder.add_layer(name, layer_confs[name][1], *ins)
+        else:
+            builder.add_vertex(name, vertex_confs[name], *ins)
+    builder.set_outputs(*outputs)
+    net = ComputationGraph(builder.build()).init()
+
+    off = 0
+
+    def take(n):
+        nonlocal off
+        out = flat[off:off + n]
+        if out.size != n:
+            raise ValueError(
+                f"coefficients.bin too short: wanted {n} at offset {off}, "
+                f"have {flat.size}")
+        off += n
+        return out
+
+    # flat walk in the REFERENCE topo order, but params land by name in
+    # this framework's own ordering (net._pidx maps names to param slots)
+    for name in topo:
+        if name not in layer_confs:
+            continue
+        tag, lc, lbody = layer_confs[name]
+        i = net._pidx[name]
+        net.state_list[i] = _consume_layer_params(
+            take, tag, lc, net.params_list[i], lbody, net.state_list[i])
+    if off != flat.size:
+        raise ValueError(
+            f"coefficients.bin length mismatch: consumed {off} of {flat.size}")
+    return net
+
+
+def export_dl4j_graph(net, path: str) -> None:
+    """Write a ComputationGraph in the reference zip format (the inverse of
+    import_dl4j_computation_graph — fixtures + hand-back interop)."""
+    from deeplearning4j_tpu.nn.conf import graph as G
+
+    conf = net.conf
+    vertices_json = {}
+    vertex_inputs = {}
+    for name, v in conf.vertices.items():
+        vertex_inputs[name] = list(conf.vertex_inputs[name])
+        if isinstance(v, G.LayerVertex):
+            # params are exported in the flat walk below; here only the conf
+            ltag, lbody, _ = _export_layer_conf_only(v.layer)
+            vertices_json[name] = {
+                "LayerVertex": {"layerConf": {"layer": {ltag: lbody}}}}
+        else:
+            vertices_json[name] = _vertex_to_json(v)
+
+    topo = _dl4j_topo_names(conf.inputs, list(conf.vertices),
+                            vertex_inputs)
+    flat_parts: List[np.ndarray] = []
+    for name in topo:
+        v = conf.vertices.get(name)
+        if not isinstance(v, G.LayerVertex):
+            continue
+        i = net._pidx[name]
+        p = {k: np.asarray(val) for k, val in net.params_list[i].items()}
+        _, _, parts = _export_layer(v.layer, p, net.state_list[i])
+        flat_parts += parts
+
+    conf_out = {
+        "networkInputs": list(conf.inputs),
+        "networkOutputs": list(conf.outputs),
+        "vertices": vertices_json,
+        "vertexInputs": vertex_inputs,
+    }
+    flat = (np.concatenate([f.astype(np.float32).reshape(-1)
+                            for f in flat_parts])
+            if flat_parts else np.zeros(0, np.float32))
+    buf = io.BytesIO()
+    write_nd4j_array(flat, buf)
+    with zipfile.ZipFile(path, "w") as zf:
+        zf.writestr("configuration.json", json.dumps(conf_out))
+        zf.writestr("coefficients.bin", buf.getvalue())
+
+
+def _export_layer_conf_only(lc) -> Tuple[str, dict, list]:
+    """Layer conf -> (tag, JSON body): run _export_layer over throwaway
+    correctly-shaped params so the body logic stays in one place."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.nn.layers.registry import (
+        init_layer_params,
+        init_layer_state,
+    )
+
+    p = {k: np.asarray(v) for k, v in init_layer_params(
+        jax.random.PRNGKey(0), lc, jnp.float32).items()}
+    st = init_layer_state(lc, jnp.float32)
+    tag, body, _ = _export_layer(lc, p, st)
+    return tag, body, []
+
+
+def _vertex_to_json(v) -> dict:
+    from deeplearning4j_tpu.nn.conf import graph as G
+
+    if isinstance(v, G.MergeVertex):
+        return {"MergeVertex": {}}
+    if isinstance(v, G.ElementWiseVertex):
+        return {"ElementWiseVertex": {"op": v.op.capitalize()}}
+    if isinstance(v, G.SubsetVertex):
+        return {"SubsetVertex": {"from": v.from_, "to": v.to}}
+    if isinstance(v, G.StackVertex):
+        return {"StackVertex": {}}
+    if isinstance(v, G.UnstackVertex):
+        return {"UnstackVertex": {"from": v.from_, "stackSize": v.stack_size}}
+    if isinstance(v, G.ScaleVertex):
+        return {"ScaleVertex": {"scaleFactor": v.scale}}
+    if isinstance(v, G.ShiftVertex):
+        return {"ShiftVertex": {"shiftFactor": v.shift}}
+    if isinstance(v, G.L2Vertex):
+        return {"L2Vertex": {}}
+    if isinstance(v, G.L2NormalizeVertex):
+        return {"L2NormalizeVertex": {}}
+    if isinstance(v, G.LastTimeStepVertex):
+        return {"LastTimeStepVertex": {"maskArrayInputName": v.mask_input}}
+    if isinstance(v, G.DuplicateToTimeSeriesVertex):
+        return {"DuplicateToTimeSeriesVertex": {"inputName": v.ref_input}}
+    raise ValueError(f"cannot export vertex {type(v).__name__}")

@@ -67,6 +67,12 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.corpus_index.argtypes = [
             ctypes.c_void_p, ctypes.c_int64,
             ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64)]
+        lib.corpus_cooc_build.restype = ctypes.c_int64
+        lib.corpus_cooc_build.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int]
+        lib.corpus_cooc_dump.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_float)]
         _lib = lib
         return _lib
 
@@ -120,6 +126,24 @@ class NativeCorpus:
             raise RuntimeError("vocab dump buffer undersized")
         words = buf.raw[:written].decode().split("\n")[:-1]
         return words, counts
+
+    def cooccurrences(self, min_count: int = 1, window: int = 5,
+                      symmetric: bool = True
+                      ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """GloVe co-occurrence COO triple (rows, cols, weights) over the
+        filtered vocab: forward-window scan, 1/distance weights,
+        mirrored when symmetric."""
+        n = self._lib.corpus_cooc_build(
+            self._h, min_count, window, int(symmetric))
+        rows = np.zeros(n, np.int32)
+        cols = np.zeros(n, np.int32)
+        vals = np.zeros(n, np.float32)
+        self._lib.corpus_cooc_dump(
+            self._h,
+            rows.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            cols.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            vals.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        return rows, cols, vals
 
     def indexed_sentences(self, min_count: int = 1) -> List[np.ndarray]:
         """Sentences as vocab-index arrays, filtered words dropped —

@@ -36,6 +36,11 @@ struct Corpus {
     int64_t cached_min_count = -1;
     std::vector<int64_t> rank;      // pre-filter id -> vocab index or -1
     std::vector<int64_t> vocab_ids; // vocab index -> pre-filter id
+
+    // GloVe co-occurrence view (built per min_count/window/symmetric)
+    int64_t cooc_min_count = -1, cooc_window = -1, cooc_symmetric = -1;
+    std::vector<int32_t> cooc_rows, cooc_cols;
+    std::vector<float> cooc_vals;
 };
 
 inline bool is_space(char c) {
@@ -159,6 +164,66 @@ void corpus_index(void* h, int64_t min_count, int32_t* tokens_out,
         tokens_out[i] = (int32_t)c->rank[c->stream[i]];
     for (size_t i = 0; i < c->sentence_offsets.size(); ++i)
         offsets_out[i] = c->sentence_offsets[i];
+}
+
+// -- GloVe co-occurrence accumulation ---------------------------------------
+// Forward-window scan with 1/distance weighting over the min_count-filtered
+// sentence stream (the AbstractCoOccurrences.java:322-374 semantics: for
+// each position x, partners j in (x, x+window]; weight 1/(j-x); symmetric
+// mirrors each increment). One C++ pass replaces the reference's
+// multi-threaded CountMap shuffling; Python receives COO arrays.
+
+int64_t corpus_cooc_build(void* h, int64_t min_count, int64_t window,
+                          int symmetric) {
+    auto* c = static_cast<Corpus*>(h);
+    if (c->cooc_min_count == min_count && c->cooc_window == window &&
+        c->cooc_symmetric == symmetric)
+        return (int64_t)c->cooc_vals.size();
+    build_ranks(c, min_count);
+    const int64_t V = (int64_t)c->vocab_ids.size();
+    std::unordered_map<int64_t, double> acc;
+    std::vector<int64_t> sent;
+    for (size_t s = 0; s + 1 < c->sentence_offsets.size(); ++s) {
+        sent.clear();
+        for (int64_t t = c->sentence_offsets[s];
+             t < c->sentence_offsets[s + 1]; ++t) {
+            int64_t r = c->rank[c->stream[t]];
+            if (r >= 0) sent.push_back(r);  // filtered words drop out
+        }
+        const int64_t n = (int64_t)sent.size();
+        for (int64_t x = 0; x < n; ++x) {
+            int64_t stop = std::min(x + window + 1, n);
+            for (int64_t j = x + 1; j < stop; ++j) {
+                double w = 1.0 / (double)(j - x);
+                acc[sent[x] * V + sent[j]] += w;
+                if (symmetric) acc[sent[j] * V + sent[x]] += w;
+            }
+        }
+    }
+    c->cooc_rows.clear(); c->cooc_cols.clear(); c->cooc_vals.clear();
+    c->cooc_rows.reserve(acc.size());
+    c->cooc_cols.reserve(acc.size());
+    c->cooc_vals.reserve(acc.size());
+    for (const auto& kv : acc) {
+        c->cooc_rows.push_back((int32_t)(kv.first / V));
+        c->cooc_cols.push_back((int32_t)(kv.first % V));
+        c->cooc_vals.push_back((float)kv.second);
+    }
+    c->cooc_min_count = min_count;
+    c->cooc_window = window;
+    c->cooc_symmetric = symmetric;
+    return (int64_t)c->cooc_vals.size();
+}
+
+void corpus_cooc_dump(void* h, int32_t* rows_out, int32_t* cols_out,
+                      float* vals_out) {
+    auto* c = static_cast<Corpus*>(h);
+    std::memcpy(rows_out, c->cooc_rows.data(),
+                c->cooc_rows.size() * sizeof(int32_t));
+    std::memcpy(cols_out, c->cooc_cols.data(),
+                c->cooc_cols.size() * sizeof(int32_t));
+    std::memcpy(vals_out, c->cooc_vals.data(),
+                c->cooc_vals.size() * sizeof(float));
 }
 
 }  // extern "C"

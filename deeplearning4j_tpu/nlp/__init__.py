@@ -28,9 +28,11 @@ from deeplearning4j_tpu.nlp.sequencevectors import (
 )
 from deeplearning4j_tpu.nlp.word2vec import Word2Vec
 from deeplearning4j_tpu.nlp.paragraphvectors import ParagraphVectors
+from deeplearning4j_tpu.nlp.glove import Glove
 from deeplearning4j_tpu.nlp.serializer import WordVectorSerializer
 
 __all__ = [
+    "Glove",
     "CommonPreprocessor",
     "DefaultTokenizerFactory",
     "NGramTokenizerFactory",

@@ -56,6 +56,11 @@ class VectorsConfiguration:
     scan_size: int = 16          # batches per device call (dispatch amortization)
     seed: int = 12345
     elements_learning_algorithm: str = "skipgram"  # or "cbow"
+    # GloVe-specific (reference: GloVe.java builder defaults)
+    x_max: float = 100.0
+    glove_alpha: float = 0.75
+    glove_symmetric: bool = True
+    glove_shuffle: bool = True
 
 
 class SequenceVectors:
@@ -136,15 +141,19 @@ class SequenceVectors:
                     seqs.append([t for t in split(line) if t])
             return self.fit(seqs)
         with native_mod.NativeCorpus(path, lowercase=lowercase) as corpus:
-            words, counts = corpus.vocab(self.conf.min_word_frequency)
-            vocab = VocabCache()
-            for w, c in zip(words, counts):
-                vocab.add(w, int(c))
-            self.vocab = vocab
-            self.build_vocab()  # huffman + lookup over the native vocab
+            self._vocab_from_native(corpus)  # huffman + lookup over it
             indexed = corpus.indexed_sentences(self.conf.min_word_frequency)
         self.train_indexed(indexed)
         return self
+
+    def _vocab_from_native(self, corpus):
+        """Adopt a NativeCorpus vocabulary and build the lookup table."""
+        words, counts = corpus.vocab(self.conf.min_word_frequency)
+        vocab = VocabCache()
+        for w, c in zip(words, counts):
+            vocab.add(w, int(c))
+        self.vocab = vocab
+        self.build_vocab()
 
     def fit(self, sequences: Optional[Iterable[Sequence[str]]] = None):
         """Build vocab (if needed) and train (reference:

@@ -178,9 +178,12 @@ def batchnorm_forward(conf: L.BatchNormalization, params, x, ctx: LayerContext):
     state = ctx.state or {}
     if ctx.training:
         if conf.lock_gamma_beta:
+            # locked = fixed at the conf constants, not trainable
+            # (reference: BatchNormalization.java lockGammaBeta applies
+            # the configured gamma/beta without learning them)
             c = params["gamma"].shape[0] if "gamma" in params else x.shape[-1]
-            gamma = jnp.ones((c,), _acc_dtype(x.dtype))
-            beta = jnp.zeros((c,), _acc_dtype(x.dtype))
+            gamma = jnp.full((c,), conf.gamma, _acc_dtype(x.dtype))
+            beta = jnp.full((c,), conf.beta, _acc_dtype(x.dtype))
         else:
             gamma, beta = params["gamma"], params["beta"]
         y, mean, var = _bn_train(x, gamma, beta, eps)
@@ -206,7 +209,8 @@ def batchnorm_forward(conf: L.BatchNormalization, params, x, ctx: LayerContext):
     inv = lax.rsqrt(var.astype(_acc_dtype(x.dtype)) + eps)
     xhat = (x - mean.astype(x.dtype)) * inv.astype(x.dtype)
     if conf.lock_gamma_beta:
-        y = xhat
+        y = jnp.asarray(conf.gamma, x.dtype) * xhat \
+            + jnp.asarray(conf.beta, x.dtype)
     else:
         y = params["gamma"].astype(x.dtype) * xhat + params["beta"].astype(x.dtype)
     return y, None

@@ -143,3 +143,159 @@ def test_length_mismatch_detected(tmp_path):
         zf.writestr("coefficients.bin", out.getvalue())
     with pytest.raises(ValueError, match="too short|mismatch"):
         import_dl4j_multilayer(path)
+
+
+# -- ComputationGraph zips ----------------------------------------------------
+
+from deeplearning4j_tpu.modelimport.dl4j import (
+    export_dl4j_graph,
+    import_dl4j_computation_graph,
+    _dl4j_topo_names,
+)
+from deeplearning4j_tpu.nn.compgraph import ComputationGraph
+from deeplearning4j_tpu.nn.conf.graph import (
+    ElementWiseVertex,
+    MergeVertex,
+)
+
+
+def _graph_net(seed=11):
+    """Diamond graph: dense branches -> merge, plus a residual elementwise
+    add and a BN layer — exercises vertex mapping AND the topological flat
+    walk (branch params interleave)."""
+    from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+    conf = (NeuralNetConfiguration.builder().seed(seed)
+            .weight_init("xavier").graph_builder()
+            .add_inputs("in")
+            .add_layer("a", DenseLayer(n_in=8, n_out=6, activation="tanh"),
+                       "in")
+            .add_layer("b", DenseLayer(n_in=8, n_out=6, activation="relu"),
+                       "in")
+            .add_vertex("add", ElementWiseVertex(op="add"), "a", "b")
+            .add_vertex("m", MergeVertex(), "a", "add")
+            .add_layer("bn", BatchNormalization(n_in=12), "m")
+            .add_layer("out", OutputLayer(n_in=12, n_out=3,
+                                          activation="softmax",
+                                          loss="mcxent"), "bn")
+            .set_outputs("out").build())
+    return ComputationGraph(conf).init()
+
+
+def test_graph_zip_round_trip(tmp_path):
+    net = _graph_net()
+    x = np.random.default_rng(3).standard_normal((5, 8)).astype(np.float32)
+    want = np.asarray(net.output(x))
+    path = tmp_path / "graph.zip"
+    export_dl4j_graph(net, str(path))
+    back = import_dl4j_computation_graph(str(path))
+    got = np.asarray(back.output(x))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_graph_import_reference_json_shape(tmp_path):
+    """A hand-built configuration.json in the exact Jackson shape
+    (WRAPPER_OBJECT vertices, networkInputs/vertexInputs field names,
+    vertices deliberately listed OUT of topological order) — pins the
+    parser to the reference format rather than to our own exporter."""
+    import io as _io
+    import json as _json
+    import zipfile as _zipfile
+    from deeplearning4j_tpu.modelimport.dl4j import write_nd4j_array
+
+    rng = np.random.default_rng(5)
+    W1 = rng.standard_normal((4, 3)).astype(np.float32)
+    b1 = rng.standard_normal(3).astype(np.float32)
+    W2 = rng.standard_normal((3, 2)).astype(np.float32)
+    b2 = rng.standard_normal(2).astype(np.float32)
+    conf = {
+        "networkInputs": ["in"],
+        "networkOutputs": ["out"],
+        # "out" listed before "h": JSON order is NOT topo order here
+        "vertices": {
+            "out": {"LayerVertex": {"layerConf": {"layer": {"output": {
+                "nin": 3, "nout": 2, "activationFn": "softmax",
+                "lossFn": "mcxent"}}}}},
+            "h": {"LayerVertex": {"layerConf": {"layer": {"dense": {
+                "nin": 4, "nout": 3, "activationFn": "tanh"}}}}},
+        },
+        "vertexInputs": {"out": ["h"], "h": ["in"]},
+    }
+    # reference flat order is topological: h first, then out
+    flat = np.concatenate([W1.reshape(-1, order="F"), b1,
+                           W2.reshape(-1, order="F"), b2])
+    buf = _io.BytesIO()
+    write_nd4j_array(flat, buf)
+    p = tmp_path / "ref_graph.zip"
+    with _zipfile.ZipFile(p, "w") as zf:
+        zf.writestr("configuration.json", _json.dumps(conf))
+        zf.writestr("coefficients.bin", buf.getvalue())
+
+    net = import_dl4j_computation_graph(str(p))
+    x = rng.standard_normal((6, 4)).astype(np.float32)
+    h = np.tanh(x @ W1 + b1)
+    logits = h @ W2 + b2
+    e = np.exp(logits - logits.max(axis=1, keepdims=True))
+    want = e / e.sum(axis=1, keepdims=True)
+    np.testing.assert_allclose(np.asarray(net.output(x)), want,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_dl4j_topo_matches_reference_kahn():
+    """FIFO Kahn with ascending-index tie-break: inputs first, then both
+    ready children in vertex-number order, etc."""
+    order = _dl4j_topo_names(
+        ["in"], ["z", "a", "out"],
+        {"z": ["in"], "a": ["in"], "out": ["z", "a"]})
+    assert order == ["in", "z", "a", "out"]
+    # diamond where JSON order disagrees with readiness
+    order = _dl4j_topo_names(
+        ["x"], ["c", "b"], {"c": ["b"], "b": ["x"]})
+    assert order == ["x", "b", "c"]
+
+
+def test_bn_lock_gamma_beta_import(tmp_path):
+    """lockGammaBeta zips carry only mean/var (2*nOut floats); gamma/beta
+    come from the conf constants (ADVICE r3 + reference
+    BatchNormalizationParamInitializer)."""
+    import io as _io
+    import json as _json
+    import zipfile as _zipfile
+    from deeplearning4j_tpu.modelimport.dl4j import write_nd4j_array
+
+    rng = np.random.default_rng(9)
+    n = 4
+    W = rng.standard_normal((n, 2)).astype(np.float32)
+    b = rng.standard_normal(2).astype(np.float32)
+    mean = rng.standard_normal(n).astype(np.float32)
+    var = (rng.random(n).astype(np.float32) + 0.5)
+    conf = {"confs": [
+        {"layer": {"batchNormalization": {
+            "nin": n, "nout": n, "eps": 1e-5, "decay": 0.9,
+            "lockGammaBeta": True, "gamma": 2.0, "beta": 0.5}}},
+        {"layer": {"output": {"nin": n, "nout": 2,
+                              "activationFn": "softmax",
+                              "lossFn": "mcxent"}}},
+    ]}
+    flat = np.concatenate([mean, var, W.reshape(-1, order="F"), b])
+    buf = _io.BytesIO()
+    write_nd4j_array(flat, buf)
+    p = tmp_path / "bn_locked.zip"
+    with _zipfile.ZipFile(p, "w") as zf:
+        zf.writestr("configuration.json", _json.dumps(conf))
+        zf.writestr("coefficients.bin", buf.getvalue())
+    net = import_dl4j_multilayer(str(p))
+    p0 = net.params_list[0]
+    np.testing.assert_allclose(np.asarray(p0["gamma"]), np.full(n, 2.0))
+    np.testing.assert_allclose(np.asarray(p0["beta"]), np.full(n, 0.5))
+    st = net.state_list[0]
+    np.testing.assert_allclose(np.asarray(st["mean"]), mean, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(st["var"]), var, rtol=1e-6)
+    # and the forward APPLIES the locked constants (gamma*xhat + beta),
+    # matching the reference's lockGammaBeta semantics
+    x = rng.standard_normal((6, n)).astype(np.float32)
+    xhat = (x - mean) / np.sqrt(var + 1e-5)
+    logits = (2.0 * xhat + 0.5) @ W + b
+    e = np.exp(logits - logits.max(axis=1, keepdims=True))
+    want = e / e.sum(axis=1, keepdims=True)
+    np.testing.assert_allclose(np.asarray(net.output(x)), want,
+                               rtol=1e-4, atol=1e-5)

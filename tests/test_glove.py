@@ -1,0 +1,121 @@
+"""GloVe (nlp/glove.py) — co-occurrence semantics, AdaGrad weighted-lsq
+training, native/Python accumulation parity, serializer round-trip.
+
+Mirrors the reference's GloveTest strategy (small corpus, similarity
+sanity) against the two-topic synthetic corpus used by the word2vec
+tests; co-occurrence values are additionally pinned by hand against the
+AbstractCoOccurrences.java:322-374 semantics (forward window, 1/distance
+weights, symmetric mirroring)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp import (
+    Glove,
+    VectorsConfiguration,
+    WordVectorSerializer,
+)
+from deeplearning4j_tpu.nlp.glove import cooccurrences_indexed
+
+ANIMALS = ["cat", "dog", "horse", "cow", "sheep"]
+TECH = ["cpu", "gpu", "ram", "disk", "cache"]
+
+
+def _corpus(n=400, seed=0):
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n):
+        group = ANIMALS if rng.random() < 0.5 else TECH
+        out.append([str(w) for w in rng.choice(group, size=8)])
+    return out
+
+
+def _to_dense(rows, cols, vals, V):
+    X = np.zeros((V, V), np.float64)
+    for r, c, v in zip(rows, cols, vals):
+        X[r, c] += v
+    return X
+
+
+def test_cooccurrence_hand_computed():
+    # sentence [0, 1, 2], window 2, symmetric:
+    #   (0,1): 1/1   (0,2): 1/2   (1,2): 1/1   + mirrors
+    rows, cols, vals = cooccurrences_indexed(
+        [np.array([0, 1, 2])], window=2, symmetric=True)
+    X = _to_dense(rows, cols, vals, 3)
+    expect = np.array([[0, 1.0, 0.5],
+                       [1.0, 0, 1.0],
+                       [0.5, 1.0, 0]])
+    np.testing.assert_allclose(X, expect)
+    # asymmetric keeps only the forward direction
+    rows, cols, vals = cooccurrences_indexed(
+        [np.array([0, 1, 2])], window=2, symmetric=False)
+    X = _to_dense(rows, cols, vals, 3)
+    np.testing.assert_allclose(X, np.triu(expect))
+    # window clips at sentence end; repeated pairs accumulate
+    rows, cols, vals = cooccurrences_indexed(
+        [np.array([0, 1, 0, 1])], window=1, symmetric=False)
+    X = _to_dense(rows, cols, vals, 2)
+    np.testing.assert_allclose(X, [[0, 2.0], [1.0, 0]])
+
+
+def test_native_matches_python_accumulation(tmp_path):
+    native_mod = pytest.importorskip("deeplearning4j_tpu.native")
+    if not native_mod.native_available():
+        pytest.skip("no C++ toolchain")
+    corpus = _corpus(60)
+    path = tmp_path / "corpus.txt"
+    path.write_text("\n".join(" ".join(s) for s in corpus) + "\n")
+    with native_mod.NativeCorpus(str(path)) as nc:
+        words, _counts = nc.vocab(1)
+        n_rows, n_cols, n_vals = nc.cooccurrences(1, window=4,
+                                                  symmetric=True)
+        indexed = nc.indexed_sentences(1)
+    rows, cols, vals = cooccurrences_indexed(indexed, window=4,
+                                             symmetric=True)
+    V = len(words)
+    np.testing.assert_allclose(_to_dense(n_rows, n_cols, n_vals, V),
+                               _to_dense(rows, cols, vals, V), rtol=1e-6)
+
+
+def test_glove_learns_clusters():
+    conf = VectorsConfiguration(
+        layer_size=24, window=4, min_word_frequency=1, epochs=25,
+        learning_rate=0.05, batch_size=1024, seed=7, x_max=10.0)
+    glove = Glove(conf, _corpus())
+    glove.fit()
+    near = [w for w, _ in glove.words_nearest("cat", 4)]
+    assert set(near) == set(ANIMALS) - {"cat"}, near
+    assert glove.similarity("cat", "dog") > glove.similarity("cat", "gpu")
+    assert np.isfinite(glove.last_loss)
+
+
+def test_glove_fit_file_native_path(tmp_path):
+    corpus = _corpus(200)
+    path = tmp_path / "corpus.txt"
+    path.write_text("\n".join(" ".join(s) for s in corpus) + "\n")
+    conf = VectorsConfiguration(
+        layer_size=16, window=4, min_word_frequency=1, epochs=20,
+        learning_rate=0.05, batch_size=1024, seed=3, x_max=10.0)
+    glove = Glove(conf)
+    glove.fit_file(str(path))
+    assert glove.similarity("cat", "dog") > glove.similarity("cat", "gpu")
+
+
+def test_glove_serializer_round_trip(tmp_path):
+    conf = VectorsConfiguration(
+        layer_size=12, window=4, min_word_frequency=1, epochs=5,
+        learning_rate=0.05, batch_size=512, seed=1, x_max=10.0)
+    glove = Glove(conf, _corpus(80))
+    glove.fit()
+    txt = tmp_path / "glove.txt"
+    WordVectorSerializer.write_word_vectors(glove, str(txt))
+    back = WordVectorSerializer.read_word_vectors(str(txt))
+    for w in ("cat", "gpu"):
+        np.testing.assert_allclose(back.word_vector(w),
+                                   glove.word_vector(w), atol=1e-5)
+    binp = tmp_path / "glove.bin"
+    WordVectorSerializer.write_google_binary(glove, str(binp))
+    back2 = WordVectorSerializer.read_google_binary(str(binp))
+    np.testing.assert_allclose(back2.word_vector("dog"),
+                               glove.word_vector("dog"), atol=1e-6)
