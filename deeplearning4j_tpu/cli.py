@@ -7,7 +7,7 @@ serialized model from flags; NearestNeighborsServer; PlayUIServer runnable).
     python -m deeplearning4j_tpu.cli knn-server --ndarray-path pts.npy
     python -m deeplearning4j_tpu.cli ui-server --stats-file stats.bin
 
-Data sources: mnist | cifar10 | iris | csv:<path>:<labelIndex>:<numClasses>
+Data sources: mnist | cifar10 | iris | lfw | csv:<path>:<labelIndex>:<numClasses>
 Model zips: this framework's format (utils/model_serializer), a DL4J
 reference zip (modelimport/dl4j), or a Keras 1.x .h5 — sniffed by
 ModelGuesser the way util/ModelGuesser.java does."""
@@ -50,7 +50,8 @@ def guess_and_load_model(path: str):
     return load_model(path)
 
 
-def _data_iterator(spec: str, batch_size: int, train: bool = True):
+def _data_iterator(spec: str, batch_size: int, train: bool = True,
+                   num_examples: int = None):
     if spec == "mnist":
         from deeplearning4j_tpu.data.mnist import (
             MnistDataFetcher,
@@ -58,16 +59,22 @@ def _data_iterator(spec: str, batch_size: int, train: bool = True):
         )
 
         return MnistDataSetIterator(
-            batch_size, train=train,
+            batch_size, train=train, num_examples=num_examples,
             fetcher=MnistDataFetcher(allow_download=True))
     if spec == "cifar10":
         from deeplearning4j_tpu.data.fetchers import CifarDataSetIterator
 
-        return CifarDataSetIterator(batch_size, train=train)
+        return CifarDataSetIterator(batch_size, train=train,
+                                    num_examples=num_examples)
     if spec == "iris":
         from deeplearning4j_tpu.data.fetchers import IrisDataSetIterator
 
         return IrisDataSetIterator(batch_size)
+    if spec == "lfw":
+        from deeplearning4j_tpu.data.fetchers import LFWDataSetIterator
+
+        return LFWDataSetIterator(batch_size, train=train,
+                                  num_examples=num_examples)
     if spec.startswith("csv:"):
         _, path, label_idx, n_classes = spec.split(":")
         from deeplearning4j_tpu.data.records import (
@@ -84,7 +91,8 @@ def _data_iterator(spec: str, batch_size: int, train: bool = True):
 
 def cmd_train(args) -> int:
     net = guess_and_load_model(args.model_path)
-    it = _data_iterator(args.data, args.batch_size)
+    it = _data_iterator(args.data, args.batch_size,
+                        num_examples=args.num_examples)
 
     listeners = []
     from deeplearning4j_tpu.train.listeners import ScoreIterationListener
@@ -99,9 +107,14 @@ def cmd_train(args) -> int:
             UIServer,
         )
 
+        from deeplearning4j_tpu.ui import ConvolutionalIterationListener
+
         storage = InMemoryStatsStorage()
         net.set_collect_stats(True)
-        listeners.append(StatsListener(storage))
+        sl = StatsListener(storage, histogram_bins=20)
+        listeners.append(sl)
+        listeners.append(ConvolutionalIterationListener(
+            storage, sl.session_id, frequency=10))
         ui_server = UIServer(storage, port=args.ui_port)
         print(f"training UI on http://127.0.0.1:{ui_server.start()}/train")
     net.set_listeners(*listeners)
@@ -172,6 +185,16 @@ def cmd_ui_server(args) -> int:
 
 
 def main(argv=None) -> int:
+    # honor JAX_PLATFORMS even when a sitecustomize imported jax before
+    # this process's env was consulted (config update beats env once the
+    # interpreter is up; backends initialize lazily)
+    import os
+
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        import jax
+
+        jax.config.update("jax_platforms", plat)
     ap = argparse.ArgumentParser(prog="deeplearning4j_tpu")
     sub = ap.add_subparsers(dest="cmd", required=True)
 
@@ -185,6 +208,8 @@ def main(argv=None) -> int:
                    help="shard batches over all visible devices")
     t.add_argument("--output", default=None)
     t.add_argument("--print-every", type=int, default=10)
+    t.add_argument("--num-examples", type=int, default=None,
+                   help="cap the training set size (mnist/cifar10/lfw)")
     t.add_argument("--ui-port", type=int, default=None)
     t.add_argument("--ui-hold", action="store_true")
     t.set_defaults(fn=cmd_train)

@@ -13,3 +13,8 @@ from deeplearning4j_tpu.data.iterators import (
     ListDataSetIterator,
     MultipleEpochsIterator,
 )
+from deeplearning4j_tpu.data.fetchers import (
+    CifarDataSetIterator,
+    IrisDataSetIterator,
+    LFWDataSetIterator,
+)

@@ -3,7 +3,9 @@ IGraph/Graph, random-walk iterators, DeepWalk + GraphHuffman +
 InMemoryGraphLookupTable, GraphVectors serving API)."""
 
 from deeplearning4j_tpu.graph.graph import Graph
-from deeplearning4j_tpu.graph.deepwalk import DeepWalk, GraphVectors
-from deeplearning4j_tpu.graph.walkers import RandomWalkIterator
+from deeplearning4j_tpu.graph.deepwalk import DeepWalk, GraphVectors, Node2Vec
+from deeplearning4j_tpu.graph.walkers import (RandomWalkIterator,
+    Node2VecWalkIterator)
 
-__all__ = ["Graph", "DeepWalk", "GraphVectors", "RandomWalkIterator"]
+__all__ = ["Graph", "DeepWalk", "GraphVectors", "Node2Vec",
+           "RandomWalkIterator", "Node2VecWalkIterator"]

@@ -58,12 +58,17 @@ class DeepWalk:
         self.batch_size = batch_size
         self.vectors: Optional[GraphVectors] = None
 
+    def _make_walker(self, graph: Graph, walk_length: int, weighted: bool,
+                     epoch: int):
+        """Walk-iterator factory — the only thing subclasses override."""
+        return RandomWalkIterator(graph, walk_length, weighted=weighted,
+                                  seed=self.seed + epoch)
+
     def fit(self, graph: Graph, walk_length: int = 40,
             weighted: bool = False) -> GraphVectors:
         walks: List[List[str]] = []
         for epoch in range(self.walks_per_vertex):
-            it = RandomWalkIterator(graph, walk_length, weighted=weighted,
-                                    seed=self.seed + epoch)
+            it = self._make_walker(graph, walk_length, weighted, epoch)
             walks.extend([str(v) for v in walk] for walk in it)
         conf = VectorsConfiguration(
             layer_size=self.vector_size,
@@ -80,3 +85,30 @@ class DeepWalk:
         sv.fit()
         self.vectors = GraphVectors(sv, graph.num_vertices)
         return self.vectors
+
+
+class Node2Vec(DeepWalk):
+    """node2vec = DeepWalk with biased 2nd-order walks (p: return
+    parameter, q: in-out parameter) feeding the same SequenceVectors
+    device step. Reference intent: models/node2vec/Node2Vec.java (a
+    deprecated stub wiring a GraphWalker into SequenceVectors — here the
+    wiring actually works)."""
+
+    def __init__(self, vector_size: int = 100, window_size: int = 5,
+                 learning_rate: float = 0.025, walks_per_vertex: int = 10,
+                 p: float = 1.0, q: float = 1.0, seed: int = 0,
+                 batch_size: int = 1024):
+        super().__init__(vector_size=vector_size, window_size=window_size,
+                         learning_rate=learning_rate,
+                         walks_per_vertex=walks_per_vertex, seed=seed,
+                         batch_size=batch_size)
+        self.p = float(p)
+        self.q = float(q)
+
+    def _make_walker(self, graph: Graph, walk_length: int, weighted: bool,
+                     epoch: int):
+        from deeplearning4j_tpu.graph.walkers import Node2VecWalkIterator
+
+        return Node2VecWalkIterator(
+            graph, walk_length, p=self.p, q=self.q, weighted=weighted,
+            seed=self.seed + epoch)

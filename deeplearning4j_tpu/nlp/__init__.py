@@ -30,9 +30,17 @@ from deeplearning4j_tpu.nlp.word2vec import Word2Vec
 from deeplearning4j_tpu.nlp.paragraphvectors import ParagraphVectors
 from deeplearning4j_tpu.nlp.glove import Glove
 from deeplearning4j_tpu.nlp.serializer import WordVectorSerializer
+from deeplearning4j_tpu.nlp.vectorizers import (
+    BagOfWordsVectorizer,
+    LabelsSource,
+    TfidfVectorizer,
+)
 
 __all__ = [
+    "BagOfWordsVectorizer",
     "Glove",
+    "LabelsSource",
+    "TfidfVectorizer",
     "CommonPreprocessor",
     "DefaultTokenizerFactory",
     "NGramTokenizerFactory",

@@ -384,7 +384,8 @@ class ComputationGraph(NetworkBase):
             mds.features, mds.labels, mds.features_masks, mds.labels_masks
         )
         self.state_list = states
-        self._notify(getattr(mds, "reported_examples", None) or mds.num_examples())
+        self._notify(getattr(mds, "reported_examples", None)
+                     or mds.num_examples(), mds)
 
     def _fit_tbptt(self, mds: MultiDataSet):
         """Truncated BPTT over a MultiDataSet: the time axis of every 3-d
@@ -428,7 +429,8 @@ class ComputationGraph(NetworkBase):
                 states, _ = self._fit_step(
                     *cut(slice(start, end)), stateful_states=states
                 )
-            self._notify(getattr(mds, "reported_examples", None) or mds.num_examples())
+            self._notify(getattr(mds, "reported_examples", None)
+                     or mds.num_examples(), mds)
         # persist only non-RNN state (running stats); RNN carry is per-batch
         self.state_list = [
             st if not _is_recurrent(lc) else self.state_list[i]

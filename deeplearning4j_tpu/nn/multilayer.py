@@ -538,7 +538,8 @@ class MultiLayerNetwork(NetworkBase):
                 ds.features, ds.labels, ds.features_mask, ds.labels_mask
             )
             self.state_list = states
-            self._notify(getattr(ds, "reported_examples", None) or ds.num_examples())
+            self._notify(getattr(ds, "reported_examples", None)
+                         or ds.num_examples(), ds)
 
     def _fit_line_search(self, ds: DataSet, algo: str):
         """Line-search optimizer path (LBFGS/CG/line GD): host-side search
@@ -567,7 +568,8 @@ class MultiLayerNetwork(NetworkBase):
         self.params_list = flat_to_params(self.layer_confs, self.params_list, new_flat)
         self._score = jnp.asarray(f_new)
         self.iteration += 1
-        self._notify(getattr(ds, "reported_examples", None) or ds.num_examples())
+        self._notify(getattr(ds, "reported_examples", None)
+                         or ds.num_examples(), ds)
 
     def _fit_tbptt(self, ds: DataSet):
         """Truncated BPTT: split time into segments of tbptt_fwd_length and
@@ -607,7 +609,8 @@ class MultiLayerNetwork(NetworkBase):
                 states, _ = self._fit_step(
                     *cut(slice(start, end)), stateful_states=states
                 )
-            self._notify(getattr(ds, "reported_examples", None) or ds.num_examples())
+            self._notify(getattr(ds, "reported_examples", None)
+                         or ds.num_examples(), ds)
         # persist only non-RNN state (running stats); RNN carry is per-batch
         self.state_list = [
             st if not _is_recurrent(conf) else self.state_list[i]

@@ -84,7 +84,7 @@ class NetworkBase:
                 self._trunc_step_fn = None
         return self
 
-    def _notify(self, batch_size):
+    def _notify(self, batch_size, ds=None):
         if not self.listeners:
             return
         info = {
@@ -92,6 +92,9 @@ class NetworkBase:
             "batch_size": batch_size,
             "etl_ms": self._last_etl_ms,
             "stats": lambda: self._last_stats,
+            # the batch that produced this iteration (activation-visualizing
+            # listeners forward it through the net; lambda keeps it lazy)
+            "batch": lambda: ds,
         }
         for lst in self.listeners:
             lst.iteration_done(self, self.iteration - 1, info)

@@ -161,9 +161,31 @@ class Evaluation(IEvaluation):
         return float(np.nanmean(per)) if not np.all(np.isnan(per)) else 0.0
 
     def f1(self, cls: Optional[int] = None) -> float:
-        p = self.precision(cls)
-        r = self.recall(cls)
-        return 2 * p * r / (p + r) if (p + r) > 0 else 0.0
+        """Per-class, binary (2-class: class 1's F1, Evaluation.java:949),
+        or macro = mean of per-class F1 over classes where precision AND
+        recall are defined (Evaluation.java:954-965 fBeta Macro — NOT the
+        harmonic mean of macro-precision/macro-recall)."""
+        if cls is not None:
+            p = self.precision(cls)
+            r = self.recall(cls)
+            return 2 * p * r / (p + r) if (p + r) > 0 else 0.0
+        n = self.confusion.shape[0] if self.confusion is not None else 0
+        if n == 2:
+            tp = float(self.confusion[1, 1])
+            fp = float(self.confusion[0, 1])
+            fn = float(self.confusion[1, 0])
+            denom = 2 * tp + fp + fn
+            return 2 * tp / denom if denom > 0 else 0.0
+        col = self.confusion.sum(axis=0).astype(np.float64)
+        row = self.confusion.sum(axis=1).astype(np.float64)
+        tp = self._tp()
+        vals = []
+        for i in range(n):
+            if col[i] == 0 or row[i] == 0:  # p or r undefined: excluded
+                continue
+            p, r = tp[i] / col[i], tp[i] / row[i]
+            vals.append(2 * p * r / (p + r) if (p + r) > 0 else 0.0)
+        return float(np.mean(vals)) if vals else 0.0
 
     def stats(self) -> str:
         n = self.confusion.shape[0] if self.confusion is not None else 0

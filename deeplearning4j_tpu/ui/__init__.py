@@ -10,7 +10,8 @@ Capability map:
   (/train/overview, /train/model, /train/system) + RemoteReceiverModule
 """
 
-from deeplearning4j_tpu.ui.stats import StatsListener
+from deeplearning4j_tpu.ui.stats import (ConvolutionalIterationListener,
+    StatsListener)
 from deeplearning4j_tpu.ui.storage import (
     FileStatsStorage,
     InMemoryStatsStorage,
@@ -20,6 +21,7 @@ from deeplearning4j_tpu.ui.storage import (
 from deeplearning4j_tpu.ui.server import UIServer
 
 __all__ = [
+    "ConvolutionalIterationListener",
     "StatsListener",
     "StatsStorage",
     "InMemoryStatsStorage",

@@ -40,7 +40,8 @@ _PAGE = """<!doctype html>
 </style></head>
 <body>
 <nav><a href="/train/overview">overview</a><a href="/train/model">model</a>
-<a href="/train/system">system</a></nav>
+<a href="/train/system">system</a><a href="/train/histogram">histogram</a>
+<a href="/train/activations">activations</a><a href="/tsne">tsne</a></nav>
 <h1>dl4j-tpu training — {title}</h1>
 <div id="content">loading…</div>
 <script>
@@ -78,6 +79,63 @@ async function refresh() {{
       for (const [name, pts] of Object.entries(layer.series))
         html += chart(name, pts, "#00695c");
     }}
+  }} else if (VIEW == "histogram") {{
+    html += `<p>iteration ${{d.iteration}}</p>`;
+    for (const [name, h] of Object.entries(d.hists || {{}})) {{
+      const n = h.counts.length, W = 380, H = 140;
+      const mx = Math.max(...h.counts, 1);
+      let bars = "";
+      for (let i = 0; i < n; i++) {{
+        const bh = (H - 20) * h.counts[i] / mx;
+        bars += `<rect x=${{(i * W / n).toFixed(1)}} y=${{(H - bh).toFixed(1)}}
+                 width=${{(W / n - 1).toFixed(1)}} height=${{bh.toFixed(1)}}
+                 fill="#1565c0"/>`;
+      }}
+      html += `<div class="chart"><h2>${{name}}</h2>
+        <svg width=${{W}} height=${{H}}>${{bars}}</svg>
+        <div style="font-size:0.7em;color:#888">
+        [${{h.edges[0].toPrecision(3)}}, ${{h.edges[n].toPrecision(3)}}]
+        </div></div>`;
+    }}
+  }} else if (VIEW == "activations") {{
+    const a = d.activations;
+    if (!a) {{ html = "no activation frames yet"; }}
+    else {{
+      html += `<p>layer ${{a.layer}}, iteration ${{d.iteration}}</p>`;
+      a.channels.forEach((ch, ci) => {{
+        const h = ch.length, w = ch[0].length, S = 4;
+        html += `<canvas id="act${{ci}}" width=${{w * S}} height=${{h * S}}
+                 style="border:1px solid #ddd;margin:4px"></canvas>`;
+      }});
+      setTimeout(() => a.channels.forEach((ch, ci) => {{
+        const h = ch.length, w = ch[0].length, S = 4;
+        const ctx = document.getElementById("act" + ci).getContext("2d");
+        for (let y = 0; y < h; y++) for (let x = 0; x < w; x++) {{
+          const v = Math.round(255 * ch[y][x]);
+          ctx.fillStyle = `rgb(${{v}},${{v}},${{v}})`;
+          ctx.fillRect(x * S, y * S, S, S);
+        }}
+      }}), 0);
+    }}
+  }} else if (VIEW == "tsne") {{
+    const W = 760, H = 560;
+    let pts = "";
+    if (d.coords && d.coords.length) {{
+      const xs = d.coords.map(c => c[0]), ys = d.coords.map(c => c[1]);
+      const x0 = Math.min(...xs), x1 = Math.max(...xs);
+      const y0 = Math.min(...ys), y1 = Math.max(...ys);
+      d.coords.forEach((c, i) => {{
+        const px = 20 + (W - 40) * (c[0] - x0) / Math.max(x1 - x0, 1e-9);
+        const py = 20 + (H - 40) * (c[1] - y0) / Math.max(y1 - y0, 1e-9);
+        pts += `<circle cx=${{px.toFixed(1)}} cy=${{py.toFixed(1)}} r=3
+                fill="#1565c0"/>`;
+        if (d.words && d.words[i])
+          pts += `<text x=${{(px + 5).toFixed(1)}} y=${{py.toFixed(1)}}
+                  font-size="10">${{d.words[i]}}</text>`;
+      }});
+    }}
+    html += `<div class="chart"><h2>t-SNE (${{(d.coords || []).length}}
+             points)</h2><svg width=${{W}} height=${{H}}>${{pts}}</svg></div>`;
   }} else {{
     html += "<table><tr><th>key</th><th>value</th></tr>";
     for (const [k,v] of Object.entries(d.static || {{}}))
@@ -99,6 +157,7 @@ class UIServer:
 
     def __init__(self, storage: StatsStorage, port: int = 9090):
         self.storage = storage
+        self._tsne = {"words": [], "coords": []}
         self._server = JsonHttpServer(get=self._get, post=self._post,
                                       port=port)
 
@@ -136,8 +195,15 @@ class UIServer:
 
         return max(ids, key=last_ts)
 
-    def _overview_data(self, session: Optional[str]) -> dict:
+    def _score_updates(self, session: Optional[str]) -> list:
+        """Training-progress records only — the stream also carries
+        activation frames (ConvolutionalIterationListener) without a
+        score."""
         ups = self.storage.get_updates(session) if session else []
+        return [u for u in ups if "score" in u]
+
+    def _overview_data(self, session: Optional[str]) -> dict:
+        ups = self._score_updates(session)
         import math
 
         def ratio(u):
@@ -161,8 +227,25 @@ class UIServer:
                 if (r := ratio(u)) is not None],
         }
 
-    def _model_data(self, session: Optional[str]) -> dict:
+    def _histogram_data(self, session: Optional[str]) -> dict:
+        """Newest parameter-histogram record (HistogramModule analog)."""
+        for u in reversed(self._score_updates(session)):
+            if "hists" in u:
+                return {"session": session, "iteration": u["iteration"],
+                        "hists": u["hists"]}
+        return {"session": session, "iteration": None, "hists": {}}
+
+    def _activations_data(self, session: Optional[str]) -> dict:
+        """Newest conv-activation frame (ConvolutionalListenerModule)."""
         ups = self.storage.get_updates(session) if session else []
+        for u in reversed(ups):
+            if "activations" in u:
+                return {"session": session, "iteration": u["iteration"],
+                        "activations": u["activations"]}
+        return {"session": session, "iteration": None, "activations": None}
+
+    def _model_data(self, session: Optional[str]) -> dict:
+        ups = self._score_updates(session)
         static = (self.storage.get_static_info(session) or {}) if session else {}
         layers = []
         for meta in static.get("layers", []):
@@ -196,12 +279,21 @@ class UIServer:
         path = urlparse(path).path.rstrip("/") or "/train/overview"
         session = self._current_session()
         pages = {"/train": "overview", "/train/overview": "overview",
-                 "/train/model": "model", "/train/system": "system"}
+                 "/train/model": "model", "/train/system": "system",
+                 "/train/histogram": "histogram",
+                 "/train/activations": "activations",
+                 "/tsne": "tsne", "/train/tsne": "tsne"}
         if path in pages:
             view = pages[path]
             return html_response(_PAGE.format(title=view, view=view))
         if path == "/train/overview/data":
             return json_response(self._overview_data(session))
+        if path == "/train/histogram/data":
+            return json_response(self._histogram_data(session))
+        if path == "/train/activations/data":
+            return json_response(self._activations_data(session))
+        if path in ("/train/tsne/data", "/tsne/data"):
+            return json_response(self._tsne)
         if path == "/train/model/data":
             return json_response(self._model_data(session))
         if path == "/train/model/graph":
@@ -218,7 +310,8 @@ class UIServer:
         return None
 
     def _post(self, path, body, headers):
-        # remote receiver (reference: RemoteReceiverModule)
+        # remote receiver (reference: RemoteReceiverModule) + t-SNE upload
+        # (reference: TsneModule POST /tsne/upload)
         session = headers.get("X-Session-Id", "remote")
         path = urlparse(path).path
         try:
@@ -226,6 +319,27 @@ class UIServer:
                 self.storage.put_static_info(session, json.loads(body))
             elif path == "/remote/update":
                 self.storage.put_update(session, decode_record(body))
+            elif path in ("/tsne/coords", "/tsne/upload"):
+                req = json.loads(body)
+                coords = [[float(a), float(b)] for a, b in req["coords"]]
+                self._tsne = {"words": list(req.get("words", [])),
+                              "coords": coords}
+            elif path == "/tsne/compute":
+                # run the device t-SNE over posted vectors (the tab the
+                # reference feeds from files; clustering/tsne.py does the
+                # math here)
+                import numpy as np
+
+                from deeplearning4j_tpu.clustering import Tsne
+
+                req = json.loads(body)
+                x = np.asarray(req["vectors"], np.float32)
+                t = Tsne(n_components=2,
+                         perplexity=float(req.get("perplexity", 20.0)),
+                         n_iter=int(req.get("iters", 300)))
+                coords = t.fit_transform(x)
+                self._tsne = {"words": list(req.get("words", [])),
+                              "coords": np.asarray(coords).tolist()}
             else:
                 return None
             return json_response({"status": "ok"})

@@ -60,12 +60,20 @@ class StatsListener(IterationListener):
                  session_id: Optional[str] = None,
                  worker_id: str = "worker0",
                  frequency: int = 1,
-                 report_memory: bool = True):
+                 report_memory: bool = True,
+                 histogram_bins: int = 0,
+                 histogram_frequency: int = 10):
         self.router = router
         self.session_id = session_id or f"session-{uuid.uuid4().hex[:8]}"
         self.worker_id = worker_id
         self.frequency = max(1, int(frequency))
         self.report_memory = report_memory
+        # >0 turns on per-layer parameter histograms (reference:
+        # HistogramModule / weights histogram tab). Histograms force a
+        # full-parameter device readback, so they run on their OWN, much
+        # slower cadence (every `histogram_frequency` iterations).
+        self.histogram_bins = int(histogram_bins)
+        self.histogram_frequency = max(1, int(histogram_frequency))
         self._sent_static = False
         self._last_time: Optional[float] = None
         self._samples_since = 0
@@ -128,4 +136,68 @@ class StatsListener(IterationListener):
             mem = _device_memory_stats()
             if mem:
                 rec["memory"] = mem
+        if self.histogram_bins > 0 and iteration % self.histogram_frequency == 0:
+            hists = {}
+            for li, p in enumerate(model.params_list):
+                for pname, v in p.items():
+                    flat = np.asarray(v).reshape(-1)
+                    counts, edges = np.histogram(flat,
+                                                 bins=self.histogram_bins)
+                    hists[f"{li}_{pname}"] = {
+                        "edges": [float(e) for e in edges],
+                        "counts": [int(c) for c in counts],
+                    }
+            rec["hists"] = hists
         self.router.put_update(self.session_id, rec)
+
+
+class ConvolutionalIterationListener(IterationListener):
+    """Streams a grid of first-conv-layer activation maps for the first
+    example of the current batch (reference: ConvolutionalIterationListener
+    + ConvolutionalListenerModule's /activations page). Stored as plain
+    nested lists in the stats stream (record key "activations"); the UI
+    renders them as canvas heatmaps — no image encoding dependency."""
+
+    def __init__(self, router: StatsStorageRouter, session_id: str,
+                 frequency: int = 10, max_channels: int = 12,
+                 max_hw: int = 24):
+        self.router = router
+        self.session_id = session_id
+        self.frequency = max(1, int(frequency))
+        self.max_channels = int(max_channels)
+        self.max_hw = int(max_hw)
+
+    def iteration_done(self, model, iteration, info):
+        if iteration % self.frequency != 0:
+            return
+        ds = info.get("batch", lambda: None)()
+        confs = getattr(model, "layer_confs", None)
+        if ds is None or confs is None:  # ComputationGraph: not wired yet
+            return
+        from deeplearning4j_tpu.nn.conf.layers import ConvolutionLayer
+
+        ci = next((i for i, c in enumerate(confs)
+                   if isinstance(c, ConvolutionLayer)), None)
+        if ci is None:
+            return
+        x = np.asarray(ds.features)[:1]
+        acts, _ = model._forward(model.params_list, model.state_list,
+                                 x, training=False, rng=None,
+                                 to_layer=ci + 1)
+        a = np.asarray(acts)[0]  # [H, W, C]
+        if a.ndim != 3:
+            return
+        sh = max(1, a.shape[0] // self.max_hw)
+        sw = max(1, a.shape[1] // self.max_hw)
+        a = a[::sh, ::sw, : self.max_channels]
+        lo, hi = float(a.min()), float(a.max())
+        a = (a - lo) / max(hi - lo, 1e-9)
+        self.router.put_update(self.session_id, {
+            "iteration": int(iteration),
+            "ts": time.time(),
+            "activations": {
+                "layer": int(ci),
+                "channels": [a[:, :, c].round(3).tolist()
+                             for c in range(a.shape[-1])],
+            },
+        })

@@ -1,0 +1,106 @@
+"""Evaluation output parity — golden values computed BY HAND from the
+reference's definitions (eval/Evaluation.java):
+
+- confusion[actual][predicted] counts over argmax'd rows, masked
+  timesteps excluded (evalTimeSeries semantics)
+- precision(i) = tp_i / colsum_i, recall(i) = tp_i / rowsum_i
+- macro precision/recall exclude 0/0 classes (Evaluation.java:572-590)
+- macro F1 = MEAN of per-class F1 over classes where both precision and
+  recall are defined (fBeta Macro, :954-965); for exactly 2 classes,
+  f1() is class 1's binary F1 (:949-952)
+"""
+
+import numpy as np
+
+from deeplearning4j_tpu.train.evaluation import Evaluation
+
+
+def _onehot(idx, k):
+    y = np.zeros((len(idx), k), np.float32)
+    y[np.arange(len(idx)), idx] = 1.0
+    return y
+
+
+def test_masked_multiclass_golden():
+    """4-class time-series with a mask; every metric pinned to values
+    computed from the reference's formulas (comments show the sums)."""
+    # [batch=2, time=4] actual / predicted class ids; mask kills 3 steps
+    actual = np.array([[0, 1, 2, 3],
+                       [1, 1, 2, 0]])
+    pred = np.array([[0, 2, 2, 3],
+                     [1, 0, 2, 3]])
+    mask = np.array([[1, 1, 1, 0],      # (0,3): actual 3/pred 3 dropped
+                     [1, 1, 0, 1]])     # (1,2): actual 2/pred 2 dropped
+    labels = _onehot(actual.reshape(-1), 4).reshape(2, 4, 4)
+    # probabilities: put 0.7 at predicted, spread the rest — argmax == pred
+    probs = np.full((8, 4), 0.1, np.float32)
+    probs[np.arange(8), pred.reshape(-1)] = 0.7
+    probs = probs.reshape(2, 4, 4)
+
+    ev = Evaluation()
+    ev.eval_batch(labels, probs, mask=mask)
+
+    # surviving (actual, pred) pairs:
+    # (0,0) (1,2) (2,2) | (1,1) (1,0) (0,3)
+    want_conf = np.zeros((4, 4), np.int64)
+    for a, p in [(0, 0), (1, 2), (2, 2), (1, 1), (1, 0), (0, 3)]:
+        want_conf[a, p] += 1
+    np.testing.assert_array_equal(ev.confusion, want_conf)
+
+    # accuracy = (tp0+tp1+tp2+tp3)/6 = (1+1+1+0)/6
+    assert abs(ev.accuracy() - 3 / 6) < 1e-9
+
+    # per-class precision: tp/colsum -> 1/2, 1/1, 1/2, 0/1
+    assert abs(ev.precision(0) - 0.5) < 1e-9
+    assert abs(ev.precision(1) - 1.0) < 1e-9
+    assert abs(ev.precision(2) - 0.5) < 1e-9
+    assert abs(ev.precision(3) - 0.0) < 1e-9
+    # macro precision: all four classes have predictions -> mean
+    assert abs(ev.precision() - (0.5 + 1.0 + 0.5 + 0.0) / 4) < 1e-9
+
+    # per-class recall: tp/rowsum -> 1/2, 1/3, 1/1, 0/0(excluded)
+    assert abs(ev.recall(0) - 0.5) < 1e-9
+    assert abs(ev.recall(1) - 1 / 3) < 1e-9
+    assert abs(ev.recall(2) - 1.0) < 1e-9
+    # class 3 has rowsum 0 -> excluded from the macro (reference NOTE)
+    want_macro_recall = (0.5 + 1 / 3 + 1.0) / 3
+    assert abs(ev.recall() - want_macro_recall) < 1e-9
+
+    # macro F1: class 3 excluded (recall undefined); per-class
+    # f1_0 = 2*.5*.5/1 = .5 ; f1_1 = 2*1*(1/3)/(4/3) = .5 ;
+    # f1_2 = 2*.5*1/1.5 = 2/3
+    want_f1 = (0.5 + 0.5 + 2 / 3) / 3
+    assert abs(ev.f1() - want_f1) < 1e-9
+
+    # stats() carries exactly these numbers
+    s = ev.stats()
+    assert f"{ev.accuracy():.4f}" in s and f"{ev.f1():.4f}" in s
+
+
+def test_two_class_f1_is_binary_class1():
+    """nClasses == 2: f1() is the class-1 binary F1 (Evaluation.java:949),
+    not a macro average."""
+    ev = Evaluation()
+    actual = [1, 1, 1, 0, 0, 1]
+    pred = [1, 0, 1, 1, 0, 1]
+    ev.eval_batch(_onehot(actual, 2), _onehot(pred, 2))
+    # tp=3 (1->1), fp=1 (0->1), fn=1 (1->0)
+    want = 2 * 3 / (2 * 3 + 1 + 1)
+    assert abs(ev.f1() - want) < 1e-9
+
+
+def test_merge_preserves_golden_values():
+    """Map-side merge (the Spark evaluation property): two partial
+    evaluations merge to the same numbers as one pass."""
+    rng = np.random.default_rng(0)
+    actual = rng.integers(0, 3, 60)
+    pred = rng.integers(0, 3, 60)
+    full = Evaluation()
+    full.eval_batch(_onehot(actual, 3), _onehot(pred, 3))
+    a, b = Evaluation(), Evaluation()
+    a.eval_batch(_onehot(actual[:25], 3), _onehot(pred[:25], 3))
+    b.eval_batch(_onehot(actual[25:], 3), _onehot(pred[25:], 3))
+    a.merge(b)
+    np.testing.assert_array_equal(a.confusion, full.confusion)
+    for m in ("accuracy", "precision", "recall", "f1"):
+        assert abs(getattr(a, m)() - getattr(full, m)()) < 1e-12

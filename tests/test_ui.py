@@ -133,3 +133,72 @@ def test_remote_router_to_ui_server():
         assert ups[-1]["grad_mm"]
     finally:
         server.stop()
+
+
+def test_histogram_tsne_activation_modules():
+    """Round-4 UI tail (reference: HistogramModule, TsneModule,
+    ConvolutionalListenerModule): train a conv net with histogram +
+    activation listeners, then pull all three new data routes."""
+    from deeplearning4j_tpu.models.lenet import lenet_conf
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.ui import ConvolutionalIterationListener
+
+    storage = InMemoryStatsStorage()
+    net = MultiLayerNetwork(lenet_conf()).init()
+    net.set_collect_stats(True)
+    sl = StatsListener(storage, session_id="ui-tail", report_memory=False,
+                       histogram_bins=16)
+    net.set_listeners(sl, ConvolutionalIterationListener(
+        storage, "ui-tail", frequency=1, max_channels=4, max_hw=8))
+    rng = np.random.default_rng(1)
+    x = rng.random((24, 784), np.float32)
+    y = np.zeros((24, 10), np.float32)
+    y[np.arange(24), rng.integers(0, 10, 24)] = 1.0
+    net.fit(x, y, batch_size=8, epochs=1, async_prefetch=False)
+
+    server = UIServer(storage, port=0)
+    port = server.start()
+    base = f"http://127.0.0.1:{port}"
+    try:
+        # histogram: every param of every layer, counts sum to param count
+        h = json.loads(urllib.request.urlopen(
+            base + "/train/histogram/data").read())
+        assert h["hists"], "no histograms collected"
+        some = next(iter(h["hists"].values()))
+        assert len(some["edges"]) == len(some["counts"]) + 1
+        n0 = int(np.prod(np.asarray(net.params_list[0]["W"]).shape))
+        assert sum(h["hists"]["0_W"]["counts"]) == n0
+
+        # activations: a grid of 2-d channel maps in [0, 1]
+        a = json.loads(urllib.request.urlopen(
+            base + "/train/activations/data").read())
+        assert a["activations"] is not None
+        chans = a["activations"]["channels"]
+        assert 1 <= len(chans) <= 4
+        arr = np.asarray(chans[0])
+        assert arr.ndim == 2 and arr.min() >= 0.0 and arr.max() <= 1.0
+
+        # overview still works with activation frames in the stream
+        o = json.loads(urllib.request.urlopen(
+            base + "/train/overview/data").read())
+        assert len(o["score"]) >= 3
+
+        # t-SNE: compute over posted vectors, then read coords back
+        vecs = np.random.default_rng(2).standard_normal((30, 8)).tolist()
+        words = [f"w{i}" for i in range(30)]
+        req = urllib.request.Request(
+            base + "/tsne/compute",
+            data=json.dumps({"vectors": vecs, "words": words,
+                             "perplexity": 5.0, "iters": 60}).encode(),
+            headers={"Content-Type": "application/json"})
+        assert json.loads(urllib.request.urlopen(req).read())["status"] == "ok"
+        t = json.loads(urllib.request.urlopen(base + "/tsne/data").read())
+        assert len(t["coords"]) == 30 and len(t["words"]) == 30
+        assert all(len(c) == 2 for c in t["coords"])
+
+        # the three pages render
+        for page in ("/train/histogram", "/train/activations", "/tsne"):
+            html = urllib.request.urlopen(base + page).read().decode()
+            assert "dl4j-tpu training" in html
+    finally:
+        server.stop()

@@ -1,0 +1,183 @@
+"""TF-IDF / bag-of-words vectorizers (nlp/vectorizers.py), node2vec
+biased walks (graph/walkers.py + graph/deepwalk.py), and the LFW fetcher
+(data/fetchers.py) — the round-4 NLP completeness sweep."""
+
+import math
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp import (
+    BagOfWordsVectorizer,
+    LabelsSource,
+    TfidfVectorizer,
+)
+
+DOCS = [
+    "the cat sat on the mat",
+    "the dog sat on the log",
+    "cats and dogs and cats",
+]
+
+
+def test_bow_per_document_counts():
+    v = BagOfWordsVectorizer().fit(DOCS)
+    row = v.transform("the cat and the cat")
+    assert row.shape == (1, v.vocab.num_words())
+    assert row[0, v.vocab.index_of("the")] == 2.0
+    assert row[0, v.vocab.index_of("cat")] == 2.0
+    assert row[0, v.vocab.index_of("and")] == 1.0
+    assert row[0, v.vocab.index_of("dog")] == 0.0
+    # unknown words are simply absent
+    assert v.vocab.index_of("zebra") == -1
+
+
+def test_tfidf_reference_formulas():
+    """tf = count/len; idf = log10(totalDocs/docFreq)
+    (TfidfVectorizer.java + MathUtils.java:258)."""
+    v = TfidfVectorizer().fit(DOCS)
+    row = v.transform("cat cat dog mat")  # len 4
+    # "cat" appears in 1 of 3 docs; tf = 2/4
+    want_cat = (2 / 4) * math.log10(3 / 1)
+    np.testing.assert_allclose(
+        row[0, v.vocab.index_of("cat")], want_cat, rtol=1e-6)
+    # "the" appears in 2 of 3 docs, absent from this doc -> 0
+    assert row[0, v.vocab.index_of("the")] == 0.0
+    # "sat" in 2/3 docs; absent here
+    want_dog = (1 / 4) * math.log10(3 / 1)
+    np.testing.assert_allclose(
+        row[0, v.vocab.index_of("dog")], want_dog, rtol=1e-6)
+
+
+def test_tfidf_vectorize_dataset_and_labels():
+    v = TfidfVectorizer().fit(DOCS, labels=["pets", "other"])
+    ds = v.vectorize("the cat sat", "pets")
+    assert ds.features.shape == (1, v.vocab.num_words())
+    assert ds.labels.shape[1] == 2 and ds.labels[0, 0] == 1.0
+    ls = LabelsSource(["a", "b"])
+    assert ls.index_of("b") == 1 and ls.index_of("missing") == -1
+
+
+def test_min_word_frequency_filters():
+    v = BagOfWordsVectorizer(min_word_frequency=2).fit(DOCS)
+    assert v.vocab.index_of("the") >= 0       # appears 4x
+    assert v.vocab.index_of("log") == -1      # appears once
+
+
+def test_tfidf_trains_classifier():
+    """End-to-end: tf-idf features feed the training stack (the
+    reference's vectorizer->DataSet->fit flow)."""
+    from deeplearning4j_tpu.nn.conf.layers import OutputLayer
+    from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    rng = np.random.default_rng(0)
+    animals = ["cat", "dog", "horse", "cow", "sheep"]
+    tech = ["cpu", "gpu", "ram", "disk", "cache"]
+    docs, labels = [], []
+    for _ in range(60):
+        grp = animals if rng.random() < 0.5 else tech
+        docs.append(" ".join(rng.choice(grp, 6)))
+        labels.append("animal" if grp is animals else "tech")
+    v = TfidfVectorizer().fit(docs, labels=["animal", "tech"])
+    X = np.concatenate([v.transform(d) for d in docs])
+    y = np.zeros((len(docs), 2), np.float32)
+    for i, l in enumerate(labels):
+        y[i, v.labels_source.index_of(l)] = 1.0
+    conf = (NeuralNetConfiguration.builder().seed(1).updater("adam")
+            .learning_rate(0.05).weight_init("xavier").list()
+            .layer(OutputLayer(n_in=X.shape[1], n_out=2,
+                               activation="softmax", loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    for _ in range(60):
+        net.fit(X, y, batch_size=32, epochs=1, async_prefetch=False)
+    acc = float(np.mean(
+        np.argmax(np.asarray(net.output(X)), -1) == np.argmax(y, -1)))
+    assert acc > 0.95, acc
+
+
+# -- node2vec ----------------------------------------------------------------
+
+def _barbell():
+    """Two 6-cliques joined by one bridge edge — communities that biased
+    walks should keep separate."""
+    from deeplearning4j_tpu.graph import Graph
+
+    g = Graph(12)
+    for base in (0, 6):
+        for i in range(6):
+            for j in range(i + 1, 6):
+                g.add_edge(base + i, base + j)
+    g.add_edge(5, 6)  # bridge
+    return g
+
+
+def test_node2vec_walk_bias():
+    """q >> 1 (BFS-ish) keeps walks near the start; with p=q=1 the walk
+    is the uniform random walk."""
+    from deeplearning4j_tpu.graph.walkers import Node2VecWalkIterator
+
+    g = _barbell()
+    # strongly discourage outward exploration: walks from clique A should
+    # almost never spend time deep inside clique B
+    it = Node2VecWalkIterator(g, walk_length=20, p=1.0, q=8.0, seed=0)
+    crossings = 0
+    for _ in range(50):
+        walk = it.walk_from(0)
+        crossings += sum(1 for v in walk if v > 6)
+    it_uniform = Node2VecWalkIterator(g, walk_length=20, p=1.0, q=1.0,
+                                      seed=0)
+    crossings_uniform = 0
+    for _ in range(50):
+        walk = it_uniform.walk_from(0)
+        crossings_uniform += sum(1 for v in walk if v > 6)
+    assert crossings < crossings_uniform, (crossings, crossings_uniform)
+
+
+def test_node2vec_learns_communities():
+    from deeplearning4j_tpu.graph import Node2Vec
+
+    g = _barbell()
+    vecs = Node2Vec(vector_size=16, window_size=4, walks_per_vertex=8,
+                    p=1.0, q=2.0, seed=3).fit(g, walk_length=12)
+    # same-clique similarity beats cross-clique similarity
+    same = np.mean([vecs.similarity(0, j) for j in range(1, 5)])
+    cross = np.mean([vecs.similarity(0, j) for j in range(7, 11)])
+    assert same > cross, (same, cross)
+    near = vecs.verts_nearest(1, 4)
+    assert all(v <= 6 for v in near), near
+
+
+# -- LFW ---------------------------------------------------------------------
+
+def test_lfw_synthetic_fallback_shapes_and_determinism():
+    from deeplearning4j_tpu.data.fetchers import (
+        LFWDataFetcher,
+        LFWDataSetIterator,
+    )
+
+    it = LFWDataSetIterator(
+        16, train=True,
+        fetcher=LFWDataFetcher(allow_download=False, synthetic_n=64,
+                               num_labels=5, image_size=32))
+    assert it.source == "synthetic"
+    ds = next(iter(it))
+    assert ds.features.shape == (16, 32, 32, 3)
+    assert ds.labels.shape == (16, 5)
+    # deterministic: same fetcher args -> same bytes
+    it2 = LFWDataSetIterator(
+        16, train=True,
+        fetcher=LFWDataFetcher(allow_download=False, synthetic_n=64,
+                               num_labels=5, image_size=32))
+    np.testing.assert_array_equal(ds.features,
+                                  next(iter(it2)).features)
+    # identities are class-consistent: nearest-centroid beats chance
+    x, y = LFWDataFetcher(allow_download=False, synthetic_n=200,
+                          num_labels=5, image_size=32).load(True)
+    labels = np.argmax(y, -1)
+    flat = x.reshape(len(x), -1)
+    cents = np.stack([flat[labels == c].mean(0) for c in range(5)])
+    pred = np.argmin(
+        ((flat[:, None, :] - cents[None]) ** 2).sum(-1), axis=1)
+    assert (pred == labels).mean() > 0.8
