@@ -15,6 +15,7 @@ samples, and scatter-adds the updates in place (donated buffers).
 """
 
 from deeplearning4j_tpu.nlp.tokenization import (
+    CJKTokenizerFactory,
     CommonPreprocessor,
     DefaultTokenizerFactory,
     NGramTokenizerFactory,
@@ -38,6 +39,7 @@ from deeplearning4j_tpu.nlp.vectorizers import (
 
 __all__ = [
     "BagOfWordsVectorizer",
+    "CJKTokenizerFactory",
     "Glove",
     "LabelsSource",
     "TfidfVectorizer",

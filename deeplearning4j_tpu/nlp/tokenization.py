@@ -90,6 +90,50 @@ class NGramTokenizerFactory(TokenizerFactory):
         return Tokenizer(out)
 
 
+class CJKTokenizerFactory(TokenizerFactory):
+    """Language plugin for unsegmented CJK text (reference:
+    deeplearning4j-nlp-japanese bundles a Kuromoji fork, -korean a KOMORAN
+    wrapper — 81 main files of bundled morphological analyzers; this
+    framework ships a dictionary-free analyzer on the same SPI instead,
+    and a full morphological analyzer plugs into the identical slot).
+
+    Segmentation: text is split into runs by character class (han,
+    hiragana, katakana, hangul, latin/digit words); han and hangul runs
+    are additionally emitted as overlapping bigrams (the Lucene
+    CJKAnalyzer strategy — robust retrieval/embedding units without a
+    lexicon), kana runs and latin words as whole tokens.
+
+    ``bigrams=False`` keeps whole runs (closer to word2vec preprocessing
+    for pre-segmented corpora)."""
+
+    _CLASSES = (
+        ("han", re.compile(r"[㐀-䶿一-鿿豈-﫿]+")),
+        ("hiragana", re.compile(r"[぀-ゟ]+")),
+        ("katakana", re.compile(r"[゠-ヿㇰ-ㇿ]+")),
+        ("hangul", re.compile(r"[가-힯ᄀ-ᇿ]+")),
+        ("word", re.compile(r"[A-Za-z0-9_]+")),
+    )
+
+    def __init__(self, bigrams: bool = True):
+        super().__init__()
+        self.bigrams = bool(bigrams)
+
+    def create(self, text: str) -> Tokenizer:
+        spans: List[tuple] = []  # (start, kind, run)
+        for kind, pat in self._CLASSES:
+            for m in pat.finditer(text):
+                spans.append((m.start(), kind, m.group()))
+        spans.sort()
+        out: List[str] = []
+        for _, kind, run in spans:
+            if (self.bigrams and kind in ("han", "hangul")
+                    and len(run) > 1):
+                out.extend(run[i:i + 2] for i in range(len(run) - 1))
+            else:
+                out.append(run)
+        return Tokenizer(self._apply_pre(out))
+
+
 class SentenceIterator:
     """Stream of sentences/documents (reference: text/sentenceiterator/).
     Any iterable of strings works; this wrapper adds reset()."""

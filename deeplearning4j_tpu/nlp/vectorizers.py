@@ -124,12 +124,20 @@ class BaseTextVectorizer:
 
     def vectorize(self, text: str, label: str) -> DataSet:
         """(text, label) -> DataSet with a one-hot label row (reference:
-        TfidfVectorizer.vectorize)."""
+        TfidfVectorizer.vectorize). The label space is FIXED by fit(...,
+        labels=...): every DataSet gets the same label width, so batches
+        stack; an unknown label is an error, not a silent widening."""
         x = self.transform(text)
+        if self.labels_source.size() == 0:
+            raise ValueError(
+                "no label space — pass labels=[...] to fit() before "
+                "vectorize()")
         li = self.labels_source.index_of(label)
         if li < 0:
-            li = self.labels_source.store(label)
-        y = np.zeros((1, max(self.labels_source.size(), li + 1)), np.float32)
+            raise ValueError(
+                f"unknown label {label!r}; known: "
+                f"{self.labels_source.labels()}")
+        y = np.zeros((1, self.labels_source.size()), np.float32)
         y[0, li] = 1.0
         return DataSet(x, y)
 

@@ -141,10 +141,14 @@ class Evaluation(IEvaluation):
         return np.diag(self.confusion).astype(np.float64)
 
     def accuracy(self) -> float:
+        if self.confusion is None:
+            return 0.0
         total = self.confusion.sum()
         return float(self._tp().sum() / total) if total else 0.0
 
     def precision(self, cls: Optional[int] = None) -> float:
+        if self.confusion is None:
+            return 0.0
         col = self.confusion.sum(axis=0).astype(np.float64)
         with np.errstate(divide="ignore", invalid="ignore"):
             per = np.where(col > 0, self._tp() / col, np.nan)
@@ -153,6 +157,8 @@ class Evaluation(IEvaluation):
         return float(np.nanmean(per)) if not np.all(np.isnan(per)) else 0.0
 
     def recall(self, cls: Optional[int] = None) -> float:
+        if self.confusion is None:
+            return 0.0
         row = self.confusion.sum(axis=1).astype(np.float64)
         with np.errstate(divide="ignore", invalid="ignore"):
             per = np.where(row > 0, self._tp() / row, np.nan)
@@ -165,11 +171,13 @@ class Evaluation(IEvaluation):
         or macro = mean of per-class F1 over classes where precision AND
         recall are defined (Evaluation.java:954-965 fBeta Macro — NOT the
         harmonic mean of macro-precision/macro-recall)."""
+        if self.confusion is None:
+            return 0.0
         if cls is not None:
             p = self.precision(cls)
             r = self.recall(cls)
             return 2 * p * r / (p + r) if (p + r) > 0 else 0.0
-        n = self.confusion.shape[0] if self.confusion is not None else 0
+        n = self.confusion.shape[0]
         if n == 2:
             tp = float(self.confusion[1, 1])
             fp = float(self.confusion[0, 1])

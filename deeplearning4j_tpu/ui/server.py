@@ -320,9 +320,14 @@ class UIServer:
             elif path == "/remote/update":
                 self.storage.put_update(session, decode_record(body))
             elif path in ("/tsne/coords", "/tsne/upload"):
+                import html
+
                 req = json.loads(body)
                 coords = [[float(a), float(b)] for a, b in req["coords"]]
-                self._tsne = {"words": list(req.get("words", [])),
+                # words are interpolated into the page's innerHTML — escape
+                # server-side so an unauthenticated poster can't plant XSS
+                self._tsne = {"words": [html.escape(str(w))
+                                        for w in req.get("words", [])],
                               "coords": coords}
             elif path == "/tsne/compute":
                 # run the device t-SNE over posted vectors (the tab the
@@ -337,8 +342,11 @@ class UIServer:
                 t = Tsne(n_components=2,
                          perplexity=float(req.get("perplexity", 20.0)),
                          n_iter=int(req.get("iters", 300)))
+                import html
+
                 coords = t.fit_transform(x)
-                self._tsne = {"words": list(req.get("words", [])),
+                self._tsne = {"words": [html.escape(str(w))
+                                        for w in req.get("words", [])],
                               "coords": np.asarray(coords).tolist()}
             else:
                 return None

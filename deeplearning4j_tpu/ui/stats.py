@@ -70,10 +70,12 @@ class StatsListener(IterationListener):
         self.report_memory = report_memory
         # >0 turns on per-layer parameter histograms (reference:
         # HistogramModule / weights histogram tab). Histograms force a
-        # full-parameter device readback, so they run on their OWN, much
-        # slower cadence (every `histogram_frequency` iterations).
+        # full-parameter device readback, so they ride every
+        # `histogram_frequency`-th REPORT (i.e. every
+        # frequency * histogram_frequency iterations).
         self.histogram_bins = int(histogram_bins)
         self.histogram_frequency = max(1, int(histogram_frequency))
+        self._reports = 0
         self._sent_static = False
         self._last_time: Optional[float] = None
         self._samples_since = 0
@@ -136,7 +138,9 @@ class StatsListener(IterationListener):
             mem = _device_memory_stats()
             if mem:
                 rec["memory"] = mem
-        if self.histogram_bins > 0 and iteration % self.histogram_frequency == 0:
+        self._reports += 1
+        if (self.histogram_bins > 0
+                and (self._reports - 1) % self.histogram_frequency == 0):
             hists = {}
             for li, p in enumerate(model.params_list):
                 for pname, v in p.items():
@@ -187,8 +191,9 @@ class ConvolutionalIterationListener(IterationListener):
         a = np.asarray(acts)[0]  # [H, W, C]
         if a.ndim != 3:
             return
-        sh = max(1, a.shape[0] // self.max_hw)
-        sw = max(1, a.shape[1] // self.max_hw)
+        # ceil division: the stride must actually cap output at max_hw
+        sh = -(-a.shape[0] // self.max_hw)
+        sw = -(-a.shape[1] // self.max_hw)
         a = a[::sh, ::sw, : self.max_channels]
         lo, hi = float(a.min()), float(a.max())
         a = (a - lo) / max(hi - lo, 1e-9)

@@ -225,3 +225,63 @@ def test_keras_backend_server(tmp_path):
         assert ev["accuracy"] > 0.8
     finally:
         server.stop()
+
+
+def test_streaming_fake_kafka_consumer_contract():
+    """A Kafka-shaped client (poll/commit, consumer-group offsets) behind
+    the callable-source SPI: every record arrives exactly once and in
+    order, offsets commit as batches are CONSUMED (at-least-once
+    delivery), and the bounded buffer exerts backpressure — the broker
+    read-ahead never exceeds buffer + in-flight slack."""
+    import threading
+    import time
+
+    class FakeKafkaConsumer:
+        """In-memory stand-in with the kafka-python surface the adapter
+        needs: poll() -> record batch or None, commit(offset)."""
+
+        def __init__(self, records):
+            self._records = records
+            self.position = 0          # next fetch offset
+            self.committed = 0         # consumer-group committed offset
+            self.max_lead = 0          # max(position - committed): slack probe
+            self._lock = threading.Lock()
+
+        def poll(self):
+            with self._lock:
+                if self.position >= len(self._records):
+                    return None
+                rec = self._records[self.position]
+                self.position += 1
+                self.max_lead = max(self.max_lead,
+                                    self.position - self.committed)
+                return rec
+
+        def commit(self, offset):
+            with self._lock:
+                self.committed = max(self.committed, offset)
+
+    rng = np.random.default_rng(1)
+    n_records, buffer_size = 40, 3
+    records = []
+    for i in range(n_records):
+        x = np.full((4, 2), float(i), np.float32)  # payload encodes offset
+        y = np.zeros((4, 2), np.float32)
+        records.append((x, y))
+    consumer = FakeKafkaConsumer(records)
+
+    def source():
+        return consumer.poll()
+
+    it = StreamingDataSetIterator(source, buffer_size=buffer_size)
+    seen = []
+    for k, ds in enumerate(it):
+        time.sleep(0.002)  # slow consumer: forces the buffer to fill
+        seen.append(float(np.asarray(ds.features)[0, 0]))
+        consumer.commit(k + 1)  # commit AFTER consumption (at-least-once)
+    # exactly once, in order
+    assert seen == [float(i) for i in range(n_records)]
+    assert consumer.committed == n_records
+    # backpressure: the pump can be at most buffer_size queued + 1 being
+    # put + 1 handed to the consumer ahead of the commit cursor
+    assert consumer.max_lead <= buffer_size + 2, consumer.max_lead

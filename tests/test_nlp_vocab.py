@@ -88,3 +88,31 @@ def test_unigram_table_distribution():
     frac_a = np.mean(table == vocab.index_of("a"))
     expected = 75**0.75 / (75**0.75 + 25**0.75)
     assert abs(frac_a - expected) < 0.02
+
+
+def test_cjk_tokenizer_factory():
+    """Language plugin on the TokenizerFactory SPI: character-class run
+    segmentation with han/hangul bigrams (Lucene CJKAnalyzer strategy in
+    place of the reference's bundled Kuromoji/KOMORAN)."""
+    from deeplearning4j_tpu.nlp import CJKTokenizerFactory
+
+    tf = CJKTokenizerFactory()
+    # Japanese: kanji run -> bigrams, kana runs whole, latin word kept
+    toks = tf.create("東京都に住むGPUユーザー").get_tokens()
+    assert "東京" in toks and "京都" in toks          # overlapping bigrams
+    assert "に" in toks                               # hiragana run
+    assert "ユーザー" in toks                          # katakana run
+    assert "GPU" in toks
+    # Korean hangul bigrams
+    toks_ko = tf.create("서울특별시").get_tokens()
+    assert "서울" in toks_ko and "울특" in toks_ko
+    # document order is preserved
+    assert toks.index("東京") < toks.index("に") < toks.index("GPU")
+    # run mode (no bigrams) keeps whole runs
+    toks_runs = CJKTokenizerFactory(bigrams=False).create(
+        "東京都に住む").get_tokens()
+    assert "東京都" in toks_runs
+    # and the plugin drives SequenceVectors like any TokenizerFactory
+    sents = [tf.create(s).get_tokens()
+             for s in ("東京の天気", "東京の電車", "大阪の天気")]
+    assert all(len(s) >= 2 for s in sents)

@@ -54,6 +54,13 @@ def test_tfidf_vectorize_dataset_and_labels():
     ds = v.vectorize("the cat sat", "pets")
     assert ds.features.shape == (1, v.vocab.num_words())
     assert ds.labels.shape[1] == 2 and ds.labels[0, 0] == 1.0
+    # label space is fixed at fit: every DataSet has the same width, and
+    # an unknown label raises instead of silently widening
+    assert v.vectorize("the dog", "other").labels.shape == (1, 2)
+    with pytest.raises(ValueError, match="unknown label"):
+        v.vectorize("the dog", "vehicles")
+    with pytest.raises(ValueError, match="no label space"):
+        BagOfWordsVectorizer().fit(DOCS).vectorize("the cat", "pets")
     ls = LabelsSource(["a", "b"])
     assert ls.index_of("b") == 1 and ls.index_of("missing") == -1
 
