@@ -281,11 +281,11 @@ class MultiLayerNetwork(NetworkBase):
             for conf, p in zip(self.layer_confs, self.params_list)
         ]
 
-    def _make_step(self, loss_builder):
-        """Generic jitted optimizer step around a loss builder
+    def _make_step_body(self, loss_builder, collect: bool = False):
+        """Unjitted optimizer-step body around a loss builder
         (p, states, data, rng) -> (score, new_states). The tail — gradient
         masking/normalization, per-leaf lr, updater, param update — is
-        shared by the standard and truncated-backward steps."""
+        shared by the standard, truncated-backward and fused-TBPTT steps."""
         gnorm = self.net_conf.gradient_normalization
         gthresh = self.net_conf.gradient_normalization_threshold
         mults = self._lr_mult_tree()
@@ -325,21 +325,28 @@ class MultiLayerNetwork(NetworkBase):
                 return new_params, merged, new_upd, score, stats
             return new_params, merged, new_upd, score
 
-        collect = bool(getattr(self, "_collect_stats", False))
+        return step
+
+    def _make_step(self, loss_builder):
+        """Jitted single-minibatch optimizer step (donated params/updater
+        buffers on device backends)."""
+        step = self._make_step_body(
+            loss_builder, collect=bool(getattr(self, "_collect_stats", False))
+        )
         backend = jax.default_backend()
         donate = (0, 2) if backend != "cpu" else ()
         return jax.jit(step, donate_argnums=donate)
 
-    def _build_train_step(self):
+    def _std_loss_builder(self):
         def loss_builder(p, states, data, rng):
             x, y, f_mask, l_mask = data
             return self._loss(p, states, x, y, f_mask, l_mask, rng)
 
-        return self._make_step(loss_builder)
+        return loss_builder
 
-    def _build_truncated_bwd_step(self):
-        """TBPTT segment step with tbptt_bwd_length < tbptt_fwd_length:
-        the segment's leading (fwd-bwd) timesteps run under stop_gradient
+    def _trunc_loss_builder(self):
+        """TBPTT loss with tbptt_bwd_length < tbptt_fwd_length: the
+        segment's leading (fwd-bwd) timesteps run under stop_gradient
         (state advances, loss counts, but no gradient flows back through
         them), truncating backprop depth to bwd_length (reference:
         tBPTTBackwardLength, MultiLayerNetwork.java:1333; the reference
@@ -364,7 +371,86 @@ class MultiLayerNetwork(NetworkBase):
             ) / (nA + nB)
             return score, self._merge_states(carried, statesB)
 
-        return self._make_step(loss_builder)
+        return loss_builder
+
+    def _build_train_step(self):
+        return self._make_step(self._std_loss_builder())
+
+    def _build_truncated_bwd_step(self):
+        return self._make_step(self._trunc_loss_builder())
+
+    def _build_tbptt_fused_step(self, n_seg: int, seg: int, bwd: int):
+        """ALL of a batch's TBPTT segments in ONE jitted dispatch.
+
+        The per-segment loop in `_fit_tbptt` costs several host->device
+        dispatches per segment (time-slices + the step); through a
+        high-latency device link that overhead dwarfs the compute for
+        small recurrent cells (measured: 9.5ms/segment dispatched vs 93us
+        of device time on the char-rnn bench). Here segment 0 runs inline
+        (populating the RNN-state carry structure) and segments 1..n-1 run
+        under `lax.scan`, so the whole fit batch is one dispatch. Exact
+        same math as the loop: same per-segment lr/t/rng, same optimizer
+        tail (equivalence pinned by tests/test_tbptt_fused.py).
+        """
+        body = self._make_step_body(
+            self._trunc_loss_builder() if bwd < seg
+            else self._std_loss_builder()
+        )
+        seed_key_base = self.net_conf.seed ^ 0x5EED
+
+        def seg_slice(a, start, length):
+            return jax.lax.dynamic_slice_in_dim(a, start, length, axis=1)
+
+        def seg_data(x, y, fm, lm, i):
+            start = i * seg
+            cut_m = lambda m, s0, ln: (
+                None if m is None else (m if m.ndim == 1
+                                        else seg_slice(m, s0, ln))
+            )
+            cut_y = lambda s0, ln: (seg_slice(y, s0, ln) if y.ndim == 3 else y)
+            if bwd < seg:
+                nA = seg - bwd
+                return (
+                    seg_slice(x, start, nA), cut_y(start, nA),
+                    cut_m(fm, start, nA), cut_m(lm, start, nA),
+                    seg_slice(x, start + nA, bwd), cut_y(start + nA, bwd),
+                    cut_m(fm, start + nA, bwd), cut_m(lm, start + nA, bwd),
+                )
+            return (seg_slice(x, start, seg), cut_y(start, seg),
+                    cut_m(fm, start, seg), cut_m(lm, start, seg))
+
+        def step(params, states, upd_state, data, lrs, t0, _rng_unused):
+            x, y, fm, lm = data
+            key = jax.random.PRNGKey(seed_key_base)
+
+            def run_seg(params, states, upd_state, i):
+                t = t0 + jnp.asarray(i, t0.dtype)
+                rng = jax.random.fold_in(key, jnp.asarray(t, jnp.uint32))
+                return body(params, states, upd_state,
+                            seg_data(x, y, fm, lm, i), lrs[i], t, rng)
+
+            # segment 0 inline: its merged states establish the carry
+            # pytree (zero-state {} -> populated h/c) for the scan
+            params, states, upd_state, s0 = run_seg(
+                params, states, upd_state, 0)
+
+            def scan_body(carry, i):
+                p, st, us = carry
+                p, st, us, score = run_seg(p, st, us, i)
+                return (p, st, us), score
+
+            (params, states, upd_state), scores = jax.lax.scan(
+                scan_body, (params, states, upd_state),
+                jnp.arange(1, n_seg))
+            # the final score returned separately so the host can keep a
+            # scalar _score without an extra device-indexing dispatch
+            last = scores[-1]
+            scores = jnp.concatenate([s0[None], scores])
+            return params, states, upd_state, scores, last
+
+        backend = jax.default_backend()
+        donate = (0, 2) if backend != "cpu" else ()
+        return jax.jit(step, donate_argnums=donate)
 
     def _run_step(self, step_fn, data, stateful_states=None):
         lr = schedule_lr(self.net_conf, self.iteration)
