@@ -379,24 +379,12 @@ class MultiLayerNetwork(NetworkBase):
     def _build_truncated_bwd_step(self):
         return self._make_step(self._trunc_loss_builder())
 
-    def _build_tbptt_fused_step(self, n_seg: int, seg: int, bwd: int):
-        """ALL of a batch's TBPTT segments in ONE jitted dispatch.
-
-        The per-segment loop in `_fit_tbptt` costs several host->device
-        dispatches per segment (time-slices + the step); through a
-        high-latency device link that overhead dwarfs the compute for
-        small recurrent cells (measured: 9.5ms/segment dispatched vs 93us
-        of device time on the char-rnn bench). Here segment 0 runs inline
-        (populating the RNN-state carry structure) and segments 1..n-1 run
-        under `lax.scan`, so the whole fit batch is one dispatch. Exact
-        same math as the loop: same per-segment lr/t/rng, same optimizer
-        tail (equivalence pinned by tests/test_tbptt_fused.py).
-        """
-        body = self._make_step_body(
-            self._trunc_loss_builder() if bwd < seg
-            else self._std_loss_builder()
-        )
-        seed_key_base = self.net_conf.seed ^ 0x5EED
+    @staticmethod
+    def _make_seg_data(seg: int, bwd: int):
+        """TBPTT time-segmentation under jit: returns seg_data(x, y, fm,
+        lm, i) -> the step-body data tuple for segment i (the 8-tuple
+        A/B split when bwd < seg, the plain 4-tuple otherwise). Uses
+        dynamic_slice so `i` may be a traced scan index."""
 
         def seg_slice(a, start, length):
             return jax.lax.dynamic_slice_in_dim(a, start, length, axis=1)
@@ -419,6 +407,37 @@ class MultiLayerNetwork(NetworkBase):
             return (seg_slice(x, start, seg), cut_y(start, seg),
                     cut_m(fm, start, seg), cut_m(lm, start, seg))
 
+        return seg_data
+
+    def _build_tbptt_fused_step(self, n_seg: int, seg: int, bwd: int):
+        """ALL of a batch's TBPTT segments in ONE jitted dispatch.
+
+        The per-segment loop in `_fit_tbptt` costs several host->device
+        dispatches per segment (time-slices + the step); through a
+        high-latency device link that overhead dwarfs the compute for
+        small recurrent cells (measured: 9.5ms/segment dispatched vs 93us
+        of device time on the char-rnn bench). Here segment 0 runs inline
+        (populating the RNN-state carry structure) and segments 1..n-1 run
+        under `lax.scan`, so the whole fit batch is one dispatch. Exact
+        same math as the loop: same per-segment lr/t/rng, same optimizer
+        tail (equivalence pinned by tests/test_tbptt_fused.py).
+
+        Callers must guarantee T == n_seg * seg (no ragged tail — the
+        fixed-size `dynamic_slice` segmentation cannot express one; the
+        loop path handles it) and that per-iteration stats collection is
+        off (the body is built without `collect`).
+        """
+        assert not getattr(self, "_collect_stats", False), (
+            "fused TBPTT does not collect per-iteration stats; "
+            "_fit_tbptt must use the loop path when collection is on"
+        )
+        body = self._make_step_body(
+            self._trunc_loss_builder() if bwd < seg
+            else self._std_loss_builder()
+        )
+        seed_key_base = self.net_conf.seed ^ 0x5EED
+        seg_data = self._make_seg_data(seg, bwd)
+
         def step(params, states, upd_state, data, lrs, t0, _rng_unused):
             x, y, fm, lm = data
             key = jax.random.PRNGKey(seed_key_base)
@@ -433,6 +452,8 @@ class MultiLayerNetwork(NetworkBase):
             # pytree (zero-state {} -> populated h/c) for the scan
             params, states, upd_state, s0 = run_seg(
                 params, states, upd_state, 0)
+            if n_seg == 1:
+                return params, states, upd_state, s0[None], s0
 
             def scan_body(carry, i):
                 p, st, us = carry
@@ -662,10 +683,25 @@ class MultiLayerNetwork(NetworkBase):
         carry RNN state across segments (reference:
         MultiLayerNetwork.doTruncatedBPTT :1333). When tbptt_bwd_length <
         tbptt_fwd_length, each segment's gradient is truncated to its last
-        bwd_length timesteps (config tBPTTBackwardLength)."""
+        bwd_length timesteps (config tBPTTBackwardLength).
+
+        When the batch has no ragged tail (T divisible by seg), no
+        listeners are attached, and stats collection is off, all segments
+        run in ONE jitted dispatch (`_build_tbptt_fused_step`) — same math,
+        ~n_seg fewer host->device round-trips. Listeners keep the loop path
+        so per-iteration callbacks observe the params of *their* iteration.
+        """
         T = ds.features.shape[1]
         seg = int(self.conf.tbptt_fwd_length)
         bwd = int(self.conf.tbptt_bwd_length)
+        n_seg = -(-T // seg)
+        if (
+            T == n_seg * seg
+            and not self.listeners
+            and not getattr(self, "_collect_stats", False)
+        ):
+            self._fit_tbptt_fused(ds, n_seg, seg, bwd)
+            return
         # seed zero RNN state for recurrent layers
         states = list(self.state_list)
         for i, conf in enumerate(self.layer_confs):
@@ -698,6 +734,241 @@ class MultiLayerNetwork(NetworkBase):
             self._notify(getattr(ds, "reported_examples", None)
                          or ds.num_examples(), ds)
         # persist only non-RNN state (running stats); RNN carry is per-batch
+        self.state_list = [
+            st if not _is_recurrent(conf) else self.state_list[i]
+            for i, (conf, st) in enumerate(zip(self.layer_confs, states))
+        ]
+
+    def _fit_tbptt_fused(self, ds: DataSet, n_seg: int, seg: int, bwd: int):
+        """Run one TBPTT fit batch through the single-dispatch fused step
+        (see `_build_tbptt_fused_step`). Host work: the lr schedule values
+        for the n_seg optimizer steps and one call."""
+        sig = (n_seg, seg, bwd)
+        cached = getattr(self, "_fused_tbptt_fn", None)
+        if cached is None or cached[0] != sig:
+            self._fused_tbptt_fn = (
+                sig, self._build_tbptt_fused_step(n_seg, seg, bwd)
+            )
+        step_fn = self._fused_tbptt_fn[1]
+        states = list(self.state_list)
+        for i, conf in enumerate(self.layer_confs):
+            if _is_recurrent(conf) and states[i] is None:
+                states[i] = {}
+        lrs = jnp.asarray(
+            [schedule_lr(self.net_conf, self.iteration + i)
+             for i in range(n_seg)],
+            jnp.float32,
+        )
+        data = tuple(
+            None if a is None else jnp.asarray(a)
+            for a in (ds.features, ds.labels, ds.features_mask,
+                      ds.labels_mask)
+        )
+        params, states, upd, _scores, last = step_fn(
+            self.params_list, states, self.upd_state, data, lrs,
+            jnp.asarray(float(self.iteration)), None,
+        )
+        self.params_list = params
+        self.upd_state = upd
+        self._score = last
+        self._last_stats = None
+        self.iteration += n_seg
+        # persist only non-RNN state (running stats); RNN carry is per-batch
+        self.state_list = [
+            st if not _is_recurrent(conf) else self.state_list[i]
+            for i, (conf, st) in enumerate(zip(self.layer_confs, states))
+        ]
+
+    # -- multi-batch fused fit (set_fused_steps) -----------------------------
+
+    def _fused_fit_supported(self) -> bool:
+        return self.net_conf.optimization_algo == "sgd"
+
+    def _fit_datasets_fused(self, ds_list):
+        """K same-shape minibatches in ONE jitted dispatch (see
+        NetworkBase.set_fused_steps). Dispatches to the cross-batch TBPTT
+        program for 3-d TBPTT batches, the stacked-scan program otherwise;
+        anything ineligible (ragged TBPTT tail) falls back per-batch."""
+        d0 = ds_list[0]
+        if (
+            self.conf.backprop_type == BackpropType.TRUNCATED_BPTT
+            and d0.features.ndim == 3
+        ):
+            T = d0.features.shape[1]
+            seg = int(self.conf.tbptt_fwd_length)
+            bwd = int(self.conf.tbptt_bwd_length)
+            n_seg = -(-T // seg)
+            if T != n_seg * seg:
+                for d in ds_list:
+                    self._fit_dataset(d)
+                return
+            self._fit_tbptt_batched(ds_list, n_seg, seg, bwd)
+            return
+        self._fit_std_batched(ds_list)
+
+    @staticmethod
+    def _stack_datasets(ds_list):
+        stack = lambda vals: (
+            None if vals[0] is None
+            else jnp.stack([jnp.asarray(v) for v in vals])
+        )
+        return (
+            stack([d.features for d in ds_list]),
+            stack([d.labels for d in ds_list]),
+            stack([d.features_mask for d in ds_list]),
+            stack([d.labels_mask for d in ds_list]),
+        )
+
+    def _build_multi_fit_step(self, K: int):
+        """K standard optimizer steps as one `lax.scan` over the stacked
+        batches — same per-step lr/t/rng derivation as `_run_step`, K-1
+        fewer dispatches (equivalence: tests/test_fused_fit.py)."""
+        assert not getattr(self, "_collect_stats", False)
+        body = self._make_step_body(self._std_loss_builder())
+        seed_key_base = self.net_conf.seed ^ 0x5EED
+
+        def step(params, states, upd_state, data_stack, lrs, t0):
+            key = jax.random.PRNGKey(seed_key_base)
+
+            def scan_body(carry, inp):
+                p, st, us = carry
+                data_i, lr, i = inp
+                t = t0 + i
+                rng = jax.random.fold_in(key, jnp.asarray(t, jnp.uint32))
+                p, st, us, sc = body(p, st, us, data_i, lr, t, rng)
+                return (p, st, us), sc
+
+            (params, states, upd_state), scores = jax.lax.scan(
+                scan_body, (params, states, upd_state),
+                (data_stack, lrs, jnp.arange(K, dtype=jnp.float32)))
+            return params, states, upd_state, scores[-1]
+
+        backend = jax.default_backend()
+        donate = (0, 2) if backend != "cpu" else ()
+        return jax.jit(step, donate_argnums=donate)
+
+    def _fit_std_batched(self, ds_list):
+        K = len(ds_list)
+        cached = getattr(self, "_multi_fit_fn", None)
+        if cached is None or cached[0] != K:
+            self._multi_fit_fn = (K, self._build_multi_fit_step(K))
+        fn = self._multi_fit_fn[1]
+        data = self._stack_datasets(ds_list)
+        lrs = jnp.asarray(
+            [schedule_lr(self.net_conf, self.iteration + i)
+             for i in range(K)], jnp.float32)
+        params, states, upd, last = fn(
+            self.params_list, self.state_list, self.upd_state, data, lrs,
+            jnp.asarray(float(self.iteration)))
+        self.params_list = params
+        self.upd_state = upd
+        self.state_list = states
+        self._score = last
+        self._last_stats = None
+        self.iteration += K
+
+    def _build_tbptt_batched_step(self, K: int, n_seg: int, seg: int,
+                                  bwd: int):
+        """K TBPTT fit batches (each n_seg segments, RNN state reset at
+        every batch boundary, BN stats carried throughout) in ONE jitted
+        dispatch. Batch 0's segment 0 runs inline to bootstrap the RNN
+        carry structure ({} -> {"h","c"}); batches 1..K-1 scan with a
+        zeros reset — identical math to K calls of `_fit_tbptt` (the
+        layer seeds zero state for {} exactly as `reset` writes zeros;
+        equivalence: tests/test_fused_fit.py)."""
+        assert not getattr(self, "_collect_stats", False)
+        body = self._make_step_body(
+            self._trunc_loss_builder() if bwd < seg
+            else self._std_loss_builder()
+        )
+        seed_key_base = self.net_conf.seed ^ 0x5EED
+        seg_data = self._make_seg_data(seg, bwd)
+        rec = [_is_recurrent(c) for c in self.layer_confs]
+
+        def reset_rnn(states):
+            return [
+                jax.tree_util.tree_map(jnp.zeros_like, st) if is_r else st
+                for st, is_r in zip(states, rec)
+            ]
+
+        def step(params, states, upd_state, data_stack, lrs, t0,
+                 _rng_unused):
+            key = jax.random.PRNGKey(seed_key_base)
+            pick = lambda b: tuple(
+                None if a is None else a[b] for a in data_stack)
+
+            def run_seg(p, st, us, data_b, i_seg, j):
+                t = t0 + jnp.asarray(j, t0.dtype)
+                rng = jax.random.fold_in(key, jnp.asarray(t, jnp.uint32))
+                x, y, fm, lm = data_b
+                return body(p, st, us, seg_data(x, y, fm, lm, i_seg),
+                            lrs[j], t, rng)
+
+            # batch 0 / segment 0 inline: bootstraps the carry structure
+            data0 = pick(0)
+            params, states, upd_state, _ = run_seg(
+                params, states, upd_state, data0, 0, 0)
+            if n_seg > 1:
+                def seg_scan0(carry, i):
+                    p, st, us = carry
+                    p, st, us, sc = run_seg(p, st, us, data0, i, i)
+                    return (p, st, us), sc
+
+                (params, states, upd_state), _ = jax.lax.scan(
+                    seg_scan0, (params, states, upd_state),
+                    jnp.arange(1, n_seg))
+
+            def batch_body(carry, b):
+                p, st, us = carry
+                st = reset_rnn(st)
+                data_b = pick(b)
+
+                def seg_scan(c2, s):
+                    p2, st2, us2 = c2
+                    p2, st2, us2, sc = run_seg(
+                        p2, st2, us2, data_b, s, b * n_seg + s)
+                    return (p2, st2, us2), sc
+
+                (p, st, us), scs = jax.lax.scan(
+                    seg_scan, (p, st, us), jnp.arange(n_seg))
+                return (p, st, us), scs[-1]
+
+            (params, states, upd_state), lasts = jax.lax.scan(
+                batch_body, (params, states, upd_state),
+                jnp.arange(1, K))
+            return params, states, upd_state, lasts[-1]
+
+        backend = jax.default_backend()
+        donate = (0, 2) if backend != "cpu" else ()
+        return jax.jit(step, donate_argnums=donate)
+
+    def _fit_tbptt_batched(self, ds_list, n_seg: int, seg: int, bwd: int):
+        K = len(ds_list)
+        if K == 1:
+            self._fit_tbptt_fused(ds_list[0], n_seg, seg, bwd)
+            return
+        sig = (K, n_seg, seg, bwd)
+        cached = getattr(self, "_tbptt_batched_fn", None)
+        if cached is None or cached[0] != sig:
+            self._tbptt_batched_fn = (
+                sig, self._build_tbptt_batched_step(K, n_seg, seg, bwd))
+        fn = self._tbptt_batched_fn[1]
+        states = list(self.state_list)
+        for i, conf in enumerate(self.layer_confs):
+            if _is_recurrent(conf) and states[i] is None:
+                states[i] = {}
+        data = self._stack_datasets(ds_list)
+        lrs = jnp.asarray(
+            [schedule_lr(self.net_conf, self.iteration + j)
+             for j in range(K * n_seg)], jnp.float32)
+        params, states, upd, last = fn(
+            self.params_list, states, self.upd_state, data, lrs,
+            jnp.asarray(float(self.iteration)), None)
+        self.params_list = params
+        self.upd_state = upd
+        self._score = last
+        self._last_stats = None
+        self.iteration += K * n_seg
         self.state_list = [
             st if not _is_recurrent(conf) else self.state_list[i]
             for i, (conf, st) in enumerate(zip(self.layer_confs, states))

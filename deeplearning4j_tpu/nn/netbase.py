@@ -46,6 +46,9 @@ class NetworkBase:
         # hook applied to each DataSet before the step — installed by
         # parallel.ParallelWrapper to shard the batch across the mesh
         self._batch_transform = None
+        # fuse K consecutive same-shape minibatches into ONE jitted
+        # dispatch (set_fused_steps) — the dispatch-latency amortizer
+        self._fused_k = 1
 
     # -- to be provided by subclasses ----------------------------------------
 
@@ -84,6 +87,46 @@ class NetworkBase:
                 self._trunc_step_fn = None
         return self
 
+    def set_fused_steps(self, k: int):
+        """Run up to `k` consecutive same-shape minibatches as ONE jitted
+        dispatch (a `lax.scan` over the stacked batches — same math, same
+        per-step lr/rng/iteration bookkeeping, k-1 fewer host->device
+        round-trips). The host-side analog of the reference's
+        AsyncDataSetIterator throughput role (MultiLayerNetwork.java:
+        1023-1025) taken to its XLA conclusion: when dispatch latency is
+        the bottleneck (small models, remote links), amortize it.
+
+        Fusion engages only when it is observationally equivalent to the
+        per-step loop: no listeners (per-iteration callbacks must see
+        their iteration's params), no stats collection, no batch
+        transform, and the subclass supports it (`_fused_fit_supported`);
+        partial/ragged chunks fall back to per-step fits."""
+        self._fused_k = max(1, int(k))
+        return self
+
+    def _fused_fit_supported(self) -> bool:
+        """Whether this network can run `_fit_datasets_fused`."""
+        return False
+
+    def _fit_datasets_fused(self, ds_list):
+        raise NotImplementedError
+
+    def _ds_signature(self, ds):
+        """Shape/mask signature — only identically-shaped consecutive
+        batches are stacked into one fused dispatch."""
+        sh = lambda a: None if a is None else tuple(a.shape)
+        if hasattr(ds, "features_masks"):  # MultiDataSet
+            return (
+                tuple(sh(f) for f in ds.features),
+                tuple(sh(y) for y in ds.labels),
+                None if ds.features_masks is None
+                else tuple(sh(m) for m in ds.features_masks),
+                None if ds.labels_masks is None
+                else tuple(sh(m) for m in ds.labels_masks),
+            )
+        return (sh(ds.features), sh(ds.labels), sh(ds.features_mask),
+                sh(ds.labels_mask))
+
     def _notify(self, batch_size, ds=None):
         if not self.listeners:
             return
@@ -105,21 +148,51 @@ class NetworkBase:
                  prefetch_buffer: int = 4):
         if async_prefetch and not isinstance(iterator, AsyncDataSetIterator):
             iterator = AsyncDataSetIterator(iterator, prefetch_buffer)
+        fuse_k = self._fused_k if (
+            self._fused_k > 1
+            and not self.listeners
+            and not self._collect_stats
+            and self._batch_transform is None
+            and self._fused_fit_supported()
+        ) else 1
         for _ in range(epochs):
             for lst in self.listeners:
                 lst.on_epoch_start(self, self.epoch)
             t_etl = time.perf_counter()
+            buf, sig = [], None
             for ds in iterator:
                 self._last_etl_ms = (time.perf_counter() - t_etl) * 1e3
                 if self._batch_transform is not None:
                     ds = self._batch_transform(ds)
-                self._fit_dataset(ds)
+                if fuse_k > 1:
+                    s = self._ds_signature(ds)
+                    if buf and s != sig:
+                        self._flush_fused(buf, fuse_k)
+                        buf = []
+                    sig = s
+                    buf.append(ds)
+                    if len(buf) == fuse_k:
+                        self._flush_fused(buf, fuse_k)
+                        buf = []
+                else:
+                    self._fit_dataset(ds)
                 t_etl = time.perf_counter()
+            if buf:
+                self._flush_fused(buf, fuse_k)
             for lst in self.listeners:
                 lst.on_epoch_end(self, self.epoch)
             self.epoch += 1
             iterator.reset()
         return self
+
+    def _flush_fused(self, buf, fuse_k):
+        """Full chunks run fused; ragged tails fall back to per-step fits
+        (one jitted program per chunk size would defeat the cache)."""
+        if len(buf) == fuse_k:
+            self._fit_datasets_fused(buf)
+        else:
+            for ds in buf:
+                self._fit_dataset(ds)
 
     # -- flattened params API ------------------------------------------------
 

@@ -1,10 +1,13 @@
-"""Scale-out: data parallelism, sharded inference, mesh utilities.
+"""Scale-out: data, tensor, sequence and pipeline parallelism.
 
 TPU-native replacement for deeplearning4j-scaleout (SURVEY.md §2.4): the
 reference's three data-parallel transports (thread-replica ParallelWrapper,
 Aeron parameter server, Spark parameter averaging) collapse into one
-mechanism here — sharded global batches + XLA GSPMD gradient allreduce over
-ICI/DCN on a `jax.sharding.Mesh`.
+data-parallel mechanism here — sharded global batches + XLA GSPMD gradient
+allreduce over ICI/DCN on a `jax.sharding.Mesh` — and the package goes
+beyond the reference with tensor parallelism (`tensor`), ring-attention
+sequence parallelism (`sequence`), and GPipe pipeline parallelism
+(`pipeline`), all composable on one mesh.
 """
 
 from deeplearning4j_tpu.parallel.mesh import (
@@ -20,8 +23,16 @@ from deeplearning4j_tpu.parallel.mesh import (
 from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
 from deeplearning4j_tpu.parallel.inference import InferenceMode, ParallelInference
 from deeplearning4j_tpu.parallel.tensor import shard_params_tp, tp_dense_specs
+from deeplearning4j_tpu.parallel.pipeline import (
+    pipeline_apply,
+    pipeline_parallel_mesh,
+    shard_stage_params,
+)
 
 __all__ = [
+    "pipeline_apply",
+    "pipeline_parallel_mesh",
+    "shard_stage_params",
     "DATA_AXIS",
     "MODEL_AXIS",
     "batch_sharded",

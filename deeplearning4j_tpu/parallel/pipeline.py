@@ -74,7 +74,12 @@ def _pipeline_body(stage_fn, stacked_params, x_mb, *, axis_name: str,
     zero_state = jnp.zeros(x_mb.shape[1:], x_mb.dtype)
 
     def tick(state, t):
-        # stage 0 ingests microbatch t (clamped: late ticks drain the pipe)
+        # stage 0 ingests microbatch t. Drain ticks (t >= M) re-feed
+        # microbatch M-1: its re-processed results can never reach the
+        # last stage within the S+M-1-tick window, so they are
+        # output-invisible (forward and backward) — deliberate trade-off
+        # keeping every tick's ops identical for XLA instead of gating
+        # stage-0 compute on t < M
         feed = lax.dynamic_index_in_dim(x_mb, jnp.clip(t, 0, M - 1), 0,
                                         keepdims=False)
         state_in = jnp.where(idx == 0, feed, state)

@@ -1,0 +1,141 @@
+"""Fused-TBPTT equivalence: the single-dispatch fused step
+(`MultiLayerNetwork._build_tbptt_fused_step`) must produce the SAME
+trajectory — params, updater state, scores, iteration count — as the
+per-segment host loop it replaces (`_fit_tbptt`'s loop path).
+
+The loop path is forced by attaching a listener (listeners pin the loop so
+per-iteration callbacks see their iteration's params); the fused path is
+the default for listener-free fits with no ragged tail. Reference
+behavior being preserved: MultiLayerNetwork.doTruncatedBPTT
+(nn/multilayer/MultiLayerNetwork.java:1333)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from deeplearning4j_tpu.data import DataSet
+from deeplearning4j_tpu.nn.conf import (
+    DenseLayer,
+    GravesLSTM,
+    InputType,
+    LSTM,
+    NeuralNetConfiguration,
+    RnnOutputLayer,
+)
+from deeplearning4j_tpu.nn.conf.network import BackpropType
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.train.listeners import IterationListener
+
+
+class _NoOpListener(IterationListener):
+    """Forces `_fit_tbptt` onto the per-segment loop path."""
+
+    def iteration_done(self, model, iteration, info):
+        pass
+
+
+def _seq_data(n=16, t=12, nin=3, nout=2, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, t, nin)).astype(np.float32)
+    cs = np.cumsum(x[..., 0], axis=1)
+    y = np.zeros((n, t, nout), np.float32)
+    y[..., 0] = (cs <= 0).astype(np.float32)
+    y[..., 1] = (cs > 0).astype(np.float32)
+    return x, y
+
+
+def _conf(fwd=4, bwd=4, *, cell=LSTM, dropout=0.0, updater="adam"):
+    return (
+        NeuralNetConfiguration.builder()
+        .seed(7)
+        .updater(updater)
+        .learning_rate(0.02)
+        .list()
+        .layer(cell(n_out=8, activation="tanh", dropout=dropout))
+        .layer(RnnOutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+        .set_input_type(InputType.recurrent(3))
+        .backprop_type(BackpropType.TRUNCATED_BPTT)
+        .t_bptt_lengths(fwd, bwd)
+        .build()
+    )
+
+
+def _max_tree_diff(a, b):
+    leaves_a = jax.tree_util.tree_leaves(a)
+    leaves_b = jax.tree_util.tree_leaves(b)
+    assert len(leaves_a) == len(leaves_b)
+    return max(
+        float(jnp.max(jnp.abs(jnp.asarray(x, jnp.float32)
+                              - jnp.asarray(y, jnp.float32))))
+        for x, y in zip(leaves_a, leaves_b)
+    ) if leaves_a else 0.0
+
+
+def _run_pair(conf_kwargs, data_kwargs=None, epochs=2, mask=False):
+    """Train one net on the loop path, one on the fused path; return both."""
+    x, y = _seq_data(**(data_kwargs or {}))
+    fm = lm = None
+    if mask:
+        t = x.shape[1]
+        lengths = np.random.default_rng(3).integers(t // 2, t + 1, x.shape[0])
+        fm = (np.arange(t)[None, :] < lengths[:, None]).astype(np.float32)
+        lm = fm.copy()
+    ds = DataSet(x, y, features_mask=fm, labels_mask=lm)
+
+    loop_net = MultiLayerNetwork(_conf(**conf_kwargs)).init()
+    loop_net.add_listener(_NoOpListener())
+    fused_net = MultiLayerNetwork(_conf(**conf_kwargs)).init()
+
+    loop_net.fit(ds, epochs=epochs, async_prefetch=False)
+    fused_net.fit(ds, epochs=epochs, async_prefetch=False)
+    return loop_net, fused_net
+
+
+@pytest.mark.parametrize("updater", ["adam", "nesterovs"])
+def test_fused_matches_loop_params_and_updater(updater):
+    loop_net, fused_net = _run_pair({"fwd": 4, "bwd": 4, "updater": updater})
+    assert fused_net.iteration == loop_net.iteration == 2 * 3  # 12/4 seg
+    assert _max_tree_diff(loop_net.params_list, fused_net.params_list) < 1e-6
+    assert _max_tree_diff(loop_net.upd_state, fused_net.upd_state) < 1e-6
+    assert abs(float(loop_net._score) - float(fused_net._score)) < 1e-6
+
+
+def test_fused_matches_loop_with_backward_truncation():
+    # bwd < fwd exercises the truncated loss builder inside the fused scan
+    loop_net, fused_net = _run_pair({"fwd": 6, "bwd": 3})
+    assert _max_tree_diff(loop_net.params_list, fused_net.params_list) < 1e-6
+    assert _max_tree_diff(loop_net.upd_state, fused_net.upd_state) < 1e-6
+
+
+def test_fused_matches_loop_with_dropout_rng():
+    # dropout consumes the per-iteration rng — pins the fused path's
+    # fold_in(key, t) derivation to the loop path's fold_in(key, iteration)
+    loop_net, fused_net = _run_pair({"fwd": 4, "bwd": 4, "dropout": 0.5})
+    assert _max_tree_diff(loop_net.params_list, fused_net.params_list) < 1e-6
+
+
+def test_fused_matches_loop_with_masks():
+    loop_net, fused_net = _run_pair({"fwd": 4, "bwd": 4}, mask=True)
+    assert _max_tree_diff(loop_net.params_list, fused_net.params_list) < 1e-6
+
+
+def test_fused_single_segment():
+    # n_seg == 1: the fused step must skip the (empty) scan
+    loop_net, fused_net = _run_pair({"fwd": 12, "bwd": 12})
+    assert fused_net.iteration == loop_net.iteration == 2
+    assert _max_tree_diff(loop_net.params_list, fused_net.params_list) < 1e-6
+
+
+def test_ragged_tail_falls_back_to_loop():
+    # T=10, seg=4 -> segments 4/4/2: fused path must decline; training
+    # still runs and matches the loop exactly (both are the loop)
+    loop_net, fused_net = _run_pair(
+        {"fwd": 4, "bwd": 4}, data_kwargs={"t": 10})
+    assert fused_net.iteration == loop_net.iteration == 2 * 3
+    assert _max_tree_diff(loop_net.params_list, fused_net.params_list) < 1e-6
+
+
+def test_graves_peepholes_fused():
+    loop_net, fused_net = _run_pair({"fwd": 4, "bwd": 4, "cell": GravesLSTM})
+    assert _max_tree_diff(loop_net.params_list, fused_net.params_list) < 1e-6
