@@ -5,8 +5,24 @@ Reference format (util/ModelSerializer.java:40,79-118): a zip holding
                         wrapped by type name, Layer.java:47-48 WRAPPER_OBJECT)
   coefficients.bin    — Nd4j.write of the single flattened f32/f64 params
                         row vector (MultiLayerNetwork.java:102 flattenedParams)
-  updaterState.bin    — optional flattened updater state (not imported —
-                        optimizer moments restart; scores/outputs don't)
+  updaterState.bin    — optional flattened updater state
+                        (ModelSerializer.java:107-119 write, :148 restore
+                        via restoreMultiLayerNetwork(file, loadUpdater)).
+                        Layout: the BaseMultiLayerUpdater state view —
+                        per contiguous UpdaterBlock (params sharing one
+                        updater configuration, BaseMultiLayerUpdater.java:
+                        63-104), the updater's state components over the
+                        block's params in flat order; two-component
+                        updaters split the block view in halves (nd4j
+                        AdamUpdater: m = first half, v = second; AdaDelta
+                        msg/msdx), single-component (Nesterovs v, AdaGrad
+                        historicalGradient, RMSProp lastGradient) use the
+                        whole view. BN running mean/var are params with
+                        updater NONE in DL4J (stateSize 0) and therefore
+                        break block contiguity; differing effective
+                        learning rates (bias_learning_rate overrides)
+                        break blocks too (UpdaterUtils
+                        updaterConfigurationsEqual).
 
 Flat layouts mirrored from nn/params/* (the load-bearing part):
   Dense/Output/RnnOutput/Embedding (DefaultParamInitializer): W [nIn,nOut]
@@ -248,18 +264,258 @@ def _consume_layer_params(take, tag: str, lc, p: dict, lj: dict, state):
     return state
 
 
+# -- updater-state flat layout (updaterState.bin) -----------------------------
+
+# nd4j GradientUpdater state components, in view order: two-component
+# updaters split their block view in halves (AdamUpdater m|v), single use
+# the whole view. sgd/none have stateSize 0 (no updaterState.bin written).
+_UPDATER_COMPONENTS = {
+    "adam": ("m", "v"), "adamax": ("m", "u"), "adadelta": ("msg", "msdx"),
+    "nesterovs": ("v",), "adagrad": ("h",), "rmsprop": ("r",),
+    "sgd": (), "none": (),
+}
+
+
+def _state_entries(lc):
+    """DL4J flat-order updater-state entries for one layer conf: a list of
+    dicts {size, to(comp_arrays)->flat, frm(flat)->{fw_name: array}, cfg}
+    where cfg is "param"/"bias" (updater-carrying, effective-lr keyed) or
+    "none" (DL4J params with updater NONE — BN running mean/var — that
+    carry no state but break block contiguity). The to/frm transforms are
+    the SAME layout maps the coefficients walk uses (f-order reshapes,
+    HWIO<->OIHW transpose, [I,F,O,G]<->[i,f,g,o] gate permutation):
+    moment arrays live in their param's layout."""
+    inner = lc.inner if isinstance(lc, L.FrozenLayer) else lc
+    entries = []
+    if isinstance(inner, (L.DenseLayer, L.OutputLayer, L.RnnOutputLayer,
+                          L.EmbeddingLayer)):
+        n_in, n_out = int(inner.n_in), int(inner.n_out)
+        entries.append(dict(
+            size=n_in * n_out,
+            to=lambda c: c["W"].reshape(-1, order="F"),
+            frm=lambda v: {"W": v.reshape((n_in, n_out), order="F")},
+            cfg="param"))
+        entries.append(dict(
+            size=n_out,
+            to=lambda c: c["b"].reshape(-1),
+            frm=lambda v: {"b": v},
+            cfg="bias"))
+    elif isinstance(inner, L.ConvolutionLayer):
+        kh, kw = (int(k) for k in inner.kernel_size)
+        n_in, n_out = int(inner.n_in), int(inner.n_out)
+        entries.append(dict(
+            size=n_out * n_in * kh * kw,
+            to=lambda c: c["W"].transpose(3, 2, 0, 1).reshape(-1, order="F"),
+            frm=lambda v: {"W": v.reshape((n_out, n_in, kh, kw),
+                                          order="F").transpose(2, 3, 1, 0)},
+            cfg="param"))
+        entries.append(dict(
+            size=n_out, to=lambda c: c["b"].reshape(-1),
+            frm=lambda v: {"b": v}, cfg="bias"))
+    elif isinstance(inner, L.BatchNormalization):
+        n = int(inner.n_in)
+        if not inner.lock_gamma_beta:
+            entries.append(dict(size=n, to=lambda c: c["gamma"].reshape(-1),
+                                frm=lambda v: {"gamma": v}, cfg="param"))
+            entries.append(dict(size=n, to=lambda c: c["beta"].reshape(-1),
+                                frm=lambda v: {"beta": v}, cfg="param"))
+        # running mean/var: DL4J params with updater NONE (stateSize 0)
+        entries.append(dict(size=n, to=None, frm=None, cfg="none"))
+        entries.append(dict(size=n, to=None, frm=None, cfg="none"))
+    elif isinstance(inner, (L.LSTM, L.GravesLSTM)):
+        graves = isinstance(inner, L.GravesLSTM)
+        n_in, H = int(inner.n_in), int(inner.n_out)
+
+        def inv(cols):
+            return np.concatenate(
+                [cols[..., 2 * H:3 * H], cols[..., H:2 * H],
+                 cols[..., 3 * H:], cols[..., :H]], axis=-1)
+
+        entries.append(dict(
+            size=n_in * 4 * H,
+            to=lambda c: inv(c["W"]).reshape(-1, order="F"),
+            frm=lambda v: {"W": _perm_ifog(
+                v.reshape((n_in, 4 * H), order="F"), H)},
+            cfg="param"))
+        rw_cols = 4 * H + (3 if graves else 0)
+
+        def rw_to(c):
+            RW = inv(c["RW"])
+            if graves:
+                RW = np.concatenate(
+                    [RW, c["pF"][:, None], c["pO"][:, None],
+                     c["pI"][:, None]], axis=1)
+            return RW.reshape(-1, order="F")
+
+        def rw_frm(v):
+            RW_full = v.reshape((H, rw_cols), order="F")
+            out = {"RW": _perm_ifog(RW_full[:, :4 * H], H)}
+            if graves:
+                out["pF"] = RW_full[:, 4 * H]
+                out["pO"] = RW_full[:, 4 * H + 1]
+                out["pI"] = RW_full[:, 4 * H + 2]
+            return out
+
+        entries.append(dict(size=H * rw_cols, to=rw_to, frm=rw_frm,
+                            cfg="param"))
+        entries.append(dict(
+            size=4 * H,
+            to=lambda c: inv(c["b"][None, :])[0],
+            frm=lambda v: {"b": _perm_ifog(v[None, :], H)[0]},
+            cfg="bias"))
+    elif isinstance(inner, (L.ActivationLayer, L.DropoutLayer,
+                            L.SubsamplingLayer, L.GlobalPoolingLayer)):
+        pass  # no params, no state
+    else:
+        raise ValueError(
+            f"no updater-state layout for layer {type(inner).__name__}")
+    return entries
+
+
+def _effective_lr(net_conf, lc, kind):
+    """Mirrors NetworkBase._lr_mult_tree: per-layer learning_rate and
+    bias_learning_rate overrides decide UpdaterBlock splits (UpdaterUtils
+    updaterConfigurationsEqual compares lr)."""
+    inner = lc.inner if isinstance(lc, L.FrozenLayer) else lc
+    if kind == "bias" and getattr(inner, "bias_learning_rate", None) is not None:
+        return inner.bias_learning_rate
+    if getattr(inner, "learning_rate", None) is not None:
+        return inner.learning_rate
+    return net_conf.learning_rate
+
+
+def _updater_blocks(net_conf, indexed_layer_confs):
+    """Group (state_idx, entry) pairs into contiguous UpdaterBlocks the
+    way BaseMultiLayerUpdater does (:63-104): a new block starts whenever
+    the effective updater configuration changes (including the NONE
+    pseudo-config of BN mean/var). Input: [(state_idx, layer_conf)] in
+    the flat-walk order."""
+    upd = net_conf.updater.lower()
+    blocks, cur_key, cur = [], None, []
+    for i, lc in indexed_layer_confs:
+        for e in _state_entries(lc):
+            key = (("none",) if e["cfg"] == "none"
+                   else (upd, _effective_lr(net_conf, lc, e["cfg"])))
+            if key != cur_key:
+                if cur:
+                    blocks.append((cur_key, cur))
+                cur_key, cur = key, []
+            cur.append((i, e))
+    if cur:
+        blocks.append((cur_key, cur))
+    return blocks
+
+
+def updater_state_to_flat(net, indexed_layer_confs=None) -> np.ndarray:
+    """The network's updater state in the reference's state-view layout
+    (what Nd4j.write(updaterState, ...) serializes)."""
+    comps = _UPDATER_COMPONENTS.get(net.updater_def.name, ())
+    pairs = (indexed_layer_confs if indexed_layer_confs is not None
+             else list(enumerate(net.layer_confs)))
+    parts = []
+    for key, entries in _updater_blocks(net.net_conf, pairs):
+        if key[0] == "none" or not comps:
+            continue
+        for comp in comps:
+            for i, e in entries:
+                st = net.upd_state[i]
+                c = {name: np.asarray(leaf[comp])
+                     for name, leaf in st.items()
+                     if isinstance(leaf, dict) and comp in leaf}
+                parts.append(np.asarray(e["to"](c), np.float32).reshape(-1))
+    return (np.concatenate(parts) if parts
+            else np.zeros(0, np.float32))
+
+
+def restore_updater_state(net, flat: np.ndarray,
+                          indexed_layer_confs=None) -> None:
+    """Inverse of updater_state_to_flat: load a reference state view into
+    the network's per-leaf updater state (resume-training parity)."""
+    import jax.numpy as jnp
+
+    comps = _UPDATER_COMPONENTS.get(net.updater_def.name, ())
+    flat = np.asarray(flat).reshape(-1)
+    if not comps:
+        if flat.size:
+            raise ValueError(
+                f"updater {net.updater_def.name!r} is stateless but "
+                f"updaterState.bin holds {flat.size} values")
+        return
+    pairs = (indexed_layer_confs if indexed_layer_confs is not None
+             else list(enumerate(net.layer_confs)))
+    blocks = _updater_blocks(net.net_conf, pairs)
+    # validate BEFORE mutating: a wrong-sized view must not leave a
+    # half-restored (corrupted old/new mix) updater state behind
+    expected = sum(
+        len(comps) * sum(e["size"] for _, e in entries)
+        for key, entries in blocks if key[0] != "none")
+    if expected != flat.size:
+        raise ValueError(
+            f"updaterState.bin length mismatch: layout expects {expected} "
+            f"values, file holds {flat.size}")
+    off = 0
+    for key, entries in blocks:
+        if key[0] == "none":
+            continue
+        for comp in comps:
+            for i, e in entries:
+                vec = flat[off:off + e["size"]]
+                off += e["size"]
+                for name, arr in e["frm"](vec).items():
+                    cur = net.upd_state[i][name][comp]
+                    net.upd_state[i][name][comp] = jnp.asarray(
+                        arr, cur.dtype).reshape(cur.shape)
+
+
+def _training_builder(confs: List[dict], bodies: List[dict],
+                      precision: str):
+    """Network builder with the training hyperparameters a DL4J zip
+    carries restored (0.8.x serializes updater/learningRate and the
+    updater's own hyperparameters per LAYER body; iterationCount sits on
+    the per-layer NeuralNetConfiguration wrapper). Without these, a
+    migrated model would resume with default sgd and the imported
+    optimizer moments would be meaningless."""
+    nc0 = confs[0] if confs else {}
+    b0 = bodies[0] if bodies else {}
+    get = lambda key, default=None: b0.get(key, nc0.get(key, default))
+    builder = NeuralNetConfiguration.builder().precision(precision)
+    updater = get("updater")
+    if updater:
+        builder = builder.updater(str(updater).lower())
+    lr = get("learningRate")
+    if lr is not None:
+        builder = builder.learning_rate(float(lr))
+    for json_key, method in (
+        ("momentum", "momentum"), ("rho", "rho"),
+        ("rmsDecay", "rms_decay"), ("adamMeanDecay", "adam_mean_decay"),
+        ("adamVarDecay", "adam_var_decay"), ("epsilon", "epsilon"),
+    ):
+        v = get(json_key)
+        if v is not None:
+            builder = getattr(builder, method)(float(v))
+    return builder
+
+
 # -- the importer ------------------------------------------------------------
 
-def import_dl4j_multilayer(path: str, precision: str = "f32"):
+def import_dl4j_multilayer(path: str, precision: str = "f32",
+                           load_updater: bool = True):
     """Load a reference-format model zip into a MultiLayerNetwork.
 
-    Returns the network with parameters (and BN running stats) restored;
-    updater state is not imported (documented above)."""
+    Returns the network with parameters, BN running stats, the updater
+    state (optimizer moments from updaterState.bin, when present and
+    load_updater — mirroring restoreMultiLayerNetwork(file, loadUpdater),
+    ModelSerializer.java:148) and the iteration counter restored, so a
+    migrated model RESUMES training rather than restarting its
+    moments."""
     from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 
     with zipfile.ZipFile(path) as zf:
         conf_json = json.loads(zf.read("configuration.json"))
         flat = read_nd4j_array(io.BytesIO(zf.read("coefficients.bin")))
+        upd_flat = None
+        if load_updater and "updaterState.bin" in zf.namelist():
+            upd_flat = read_nd4j_array(io.BytesIO(zf.read("updaterState.bin")))
     flat = np.asarray(flat).reshape(-1)
 
     confs = conf_json.get("confs", [])
@@ -275,7 +531,8 @@ def import_dl4j_multilayer(path: str, precision: str = "f32"):
         tags.append(tag)
         bodies.append(body)
 
-    builder = NeuralNetConfiguration.builder().precision(precision).list()
+    builder = _training_builder(confs, bodies, precision).list()
+    iteration = int((confs[0] if confs else {}).get("iterationCount", 0))
     for l in layers:
         builder = builder.layer(l)
     # input type from the first layer's nIn (feed-forward/recurrent import;
@@ -306,6 +563,9 @@ def import_dl4j_multilayer(path: str, precision: str = "f32"):
     if off != flat.size:
         raise ValueError(
             f"coefficients.bin length mismatch: consumed {off} of {flat.size}")
+    net.iteration = iteration
+    if upd_flat is not None:
+        restore_updater_state(net, np.asarray(upd_flat).reshape(-1))
     return net
 
 
@@ -400,16 +660,41 @@ def _export_layer(lc, p: dict, st) -> Tuple[str, dict, List[np.ndarray]]:
     return tag, body, flat_parts
 
 
-def export_dl4j_zip(net, path: str) -> None:
+def _conf_training_json(net) -> dict:
+    """Per-layer-body training hyperparameters, reference style."""
+    nc = net.net_conf
+    out = {"updater": nc.updater.upper(), "learningRate": nc.learning_rate}
+    per_updater = {
+        "nesterovs": {"momentum": nc.momentum},
+        "adam": {"adamMeanDecay": nc.adam_mean_decay,
+                 "adamVarDecay": nc.adam_var_decay, "epsilon": nc.epsilon},
+        "adamax": {"adamMeanDecay": nc.adam_mean_decay,
+                   "adamVarDecay": nc.adam_var_decay, "epsilon": nc.epsilon},
+        "adadelta": {"rho": nc.rho, "epsilon": nc.epsilon},
+        "rmsprop": {"rmsDecay": nc.rms_decay, "epsilon": nc.epsilon},
+        "adagrad": {"epsilon": nc.epsilon},
+    }
+    out.update(per_updater.get(nc.updater.lower(), {}))
+    return out
+
+
+def export_dl4j_zip(net, path: str, save_updater: bool = True) -> None:
     """Write a network in the reference zip format (the inverse mapping of
     import_dl4j_multilayer — used for fixtures and for handing models back
-    to reference-era tooling). Only layer types listed above."""
+    to reference-era tooling). Only layer types listed above. With
+    save_updater (the reference's writeModel saveUpdater flag), the
+    optimizer state view goes to updaterState.bin and the per-conf
+    iterationCount is emitted, so import->resume matches uninterrupted
+    training."""
+    train_json = _conf_training_json(net)
     conf_out = {"confs": []}
     flat_parts: List[np.ndarray] = []
     for i, lc in enumerate(net.layer_confs):
         p = {k: np.asarray(v) for k, v in net.params_list[i].items()}
         tag, body, parts = _export_layer(lc, p, net.state_list[i])
-        conf_out["confs"].append({"layer": {tag: body}})
+        body = {**body, **train_json}
+        conf_out["confs"].append({"layer": {tag: body},
+                                  "iterationCount": int(net.iteration)})
         flat_parts += parts
 
     flat = (np.concatenate([f.astype(np.float32).reshape(-1)
@@ -420,6 +705,12 @@ def export_dl4j_zip(net, path: str) -> None:
     with zipfile.ZipFile(path, "w") as zf:
         zf.writestr("configuration.json", json.dumps(conf_out))
         zf.writestr("coefficients.bin", buf.getvalue())
+        if save_updater:
+            upd = updater_state_to_flat(net)
+            if upd.size:
+                ubuf = io.BytesIO()
+                write_nd4j_array(upd, ubuf)
+                zf.writestr("updaterState.bin", ubuf.getvalue())
 
 
 # -- ComputationGraph zips ----------------------------------------------------
@@ -490,16 +781,21 @@ def _map_vertex(tag: str, body: dict):
     raise ValueError(f"unsupported DL4J graph vertex type {tag!r} for import")
 
 
-def import_dl4j_computation_graph(path: str, precision: str = "f32"):
+def import_dl4j_computation_graph(path: str, precision: str = "f32",
+                                  load_updater: bool = True):
     """Load a reference-format ComputationGraph zip
     (ModelSerializer.java:228 restoreComputationGraph) into a
-    ComputationGraph with parameters and BN stats restored."""
+    ComputationGraph with parameters, BN stats and (load_updater) the
+    optimizer moments + iteration counter restored."""
     from deeplearning4j_tpu.nn.compgraph import ComputationGraph
     from deeplearning4j_tpu.nn.conf import graph as G
 
     with zipfile.ZipFile(path) as zf:
         cj = json.loads(zf.read("configuration.json"))
         flat = read_nd4j_array(io.BytesIO(zf.read("coefficients.bin")))
+        upd_flat = None
+        if load_updater and "updaterState.bin" in zf.namelist():
+            upd_flat = read_nd4j_array(io.BytesIO(zf.read("updaterState.bin")))
     flat = np.asarray(flat).reshape(-1)
 
     inputs = list(cj["networkInputs"])
@@ -522,8 +818,13 @@ def import_dl4j_computation_graph(path: str, precision: str = "f32"):
 
     topo = _dl4j_topo_names(inputs, list(vertices_json), vertex_inputs)
 
-    builder = (NeuralNetConfiguration.builder().precision(precision)
-               .graph_builder().add_inputs(*inputs))
+    lbodies = [layer_confs[n][2] for n in topo if n in layer_confs]
+    builder = (_training_builder(
+        [cj.get("defaultConfiguration", cj)], lbodies, precision)
+        .graph_builder().add_inputs(*inputs))
+    iteration = int(cj.get("iterationCount",
+                           cj.get("defaultConfiguration", {})
+                           .get("iterationCount", 0)))
     for name in topo:  # topo order satisfies inputs-before-use
         if name in inputs:
             continue
@@ -559,15 +860,23 @@ def import_dl4j_computation_graph(path: str, precision: str = "f32"):
     if off != flat.size:
         raise ValueError(
             f"coefficients.bin length mismatch: consumed {off} of {flat.size}")
+    net.iteration = iteration
+    if upd_flat is not None:
+        pairs = [(net._pidx[n], layer_confs[n][1])
+                 for n in topo if n in layer_confs]
+        restore_updater_state(net, np.asarray(upd_flat).reshape(-1),
+                              indexed_layer_confs=pairs)
     return net
 
 
-def export_dl4j_graph(net, path: str) -> None:
+def export_dl4j_graph(net, path: str, save_updater: bool = True) -> None:
     """Write a ComputationGraph in the reference zip format (the inverse of
-    import_dl4j_computation_graph — fixtures + hand-back interop)."""
+    import_dl4j_computation_graph — fixtures + hand-back interop), with
+    updaterState.bin + iterationCount when save_updater."""
     from deeplearning4j_tpu.nn.conf import graph as G
 
     conf = net.conf
+    train_json = _conf_training_json(net)
     vertices_json = {}
     vertex_inputs = {}
     for name, v in conf.vertices.items():
@@ -576,7 +885,8 @@ def export_dl4j_graph(net, path: str) -> None:
             # params are exported in the flat walk below; here only the conf
             ltag, lbody, _ = _export_layer_conf_only(v.layer)
             vertices_json[name] = {
-                "LayerVertex": {"layerConf": {"layer": {ltag: lbody}}}}
+                "LayerVertex": {"layerConf": {
+                    "layer": {ltag: {**lbody, **train_json}}}}}
         else:
             vertices_json[name] = _vertex_to_json(v)
 
@@ -597,6 +907,7 @@ def export_dl4j_graph(net, path: str) -> None:
         "networkOutputs": list(conf.outputs),
         "vertices": vertices_json,
         "vertexInputs": vertex_inputs,
+        "iterationCount": int(net.iteration),
     }
     flat = (np.concatenate([f.astype(np.float32).reshape(-1)
                             for f in flat_parts])
@@ -606,6 +917,15 @@ def export_dl4j_graph(net, path: str) -> None:
     with zipfile.ZipFile(path, "w") as zf:
         zf.writestr("configuration.json", json.dumps(conf_out))
         zf.writestr("coefficients.bin", buf.getvalue())
+        if save_updater:
+            pairs = [(net._pidx[n], conf.vertices[n].layer)
+                     for n in topo
+                     if isinstance(conf.vertices.get(n), G.LayerVertex)]
+            upd = updater_state_to_flat(net, indexed_layer_confs=pairs)
+            if upd.size:
+                ubuf = io.BytesIO()
+                write_nd4j_array(upd, ubuf)
+                zf.writestr("updaterState.bin", ubuf.getvalue())
 
 
 def _export_layer_conf_only(lc) -> Tuple[str, dict, list]:

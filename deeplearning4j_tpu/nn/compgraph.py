@@ -436,7 +436,7 @@ class ComputationGraph(NetworkBase):
              for i in range(K)], jnp.float32)
         params, states, upd, last = fn(
             self.params_list, self.state_list, self.upd_state,
-            xs, ys, fms, lms, lrs, jnp.asarray(float(self.iteration)))
+            xs, ys, fms, lms, lrs, jnp.asarray(self.iteration, jnp.uint32))
         self.params_list = params
         self.upd_state = upd
         self.state_list = states
@@ -453,20 +453,22 @@ class ComputationGraph(NetworkBase):
         seed_key_base = self.net_conf.seed ^ 0x5EED
 
         def step(params, states, upd_state, xs, ys, fms, lms, lrs, t0):
+            # t0: exact uint32 iteration counter (float32 would collapse
+            # consecutive steps' dropout rng past 2^24 iterations)
             key = jax.random.PRNGKey(seed_key_base)
 
             def scan_body(carry, inp):
                 p, st, us = carry
                 xs_i, ys_i, fms_i, lms_i, lr, i = inp
-                t = t0 + i
-                rng = jax.random.fold_in(key, jnp.asarray(t, jnp.uint32))
+                ti = t0 + i
+                rng = jax.random.fold_in(key, ti)
                 p, st, us, sc = body(p, st, us, xs_i, ys_i, fms_i, lms_i,
-                                     lr, t, rng)
+                                     lr, ti.astype(jnp.float32), rng)
                 return (p, st, us), sc
 
             (params, states, upd_state), scores = jax.lax.scan(
                 scan_body, (params, states, upd_state),
-                (xs, ys, fms, lms, lrs, jnp.arange(K, dtype=jnp.float32)))
+                (xs, ys, fms, lms, lrs, jnp.arange(K, dtype=jnp.uint32)))
             return params, states, upd_state, scores[-1]
 
         backend = jax.default_backend()

@@ -439,14 +439,18 @@ class MultiLayerNetwork(NetworkBase):
         seg_data = self._make_seg_data(seg, bwd)
 
         def step(params, states, upd_state, data, lrs, t0, _rng_unused):
+            # t0 is the iteration counter as EXACT uint32 — deriving it
+            # from a float32 t would collapse consecutive steps (and their
+            # dropout rng) past 2^24 iterations
             x, y, fm, lm = data
             key = jax.random.PRNGKey(seed_key_base)
 
             def run_seg(params, states, upd_state, i):
-                t = t0 + jnp.asarray(i, t0.dtype)
-                rng = jax.random.fold_in(key, jnp.asarray(t, jnp.uint32))
+                ti = t0 + jnp.asarray(i, t0.dtype)
+                rng = jax.random.fold_in(key, ti)
                 return body(params, states, upd_state,
-                            seg_data(x, y, fm, lm, i), lrs[i], t, rng)
+                            seg_data(x, y, fm, lm, i), lrs[i],
+                            ti.astype(jnp.float32), rng)
 
             # segment 0 inline: its merged states establish the carry
             # pytree (zero-state {} -> populated h/c) for the scan
@@ -766,7 +770,7 @@ class MultiLayerNetwork(NetworkBase):
         )
         params, states, upd, _scores, last = step_fn(
             self.params_list, states, self.upd_state, data, lrs,
-            jnp.asarray(float(self.iteration)), None,
+            jnp.asarray(self.iteration, jnp.uint32), None,
         )
         self.params_list = params
         self.upd_state = upd
@@ -828,19 +832,21 @@ class MultiLayerNetwork(NetworkBase):
         seed_key_base = self.net_conf.seed ^ 0x5EED
 
         def step(params, states, upd_state, data_stack, lrs, t0):
+            # t0: exact uint32 iteration counter (see _build_tbptt_fused_step)
             key = jax.random.PRNGKey(seed_key_base)
 
             def scan_body(carry, inp):
                 p, st, us = carry
                 data_i, lr, i = inp
-                t = t0 + i
-                rng = jax.random.fold_in(key, jnp.asarray(t, jnp.uint32))
-                p, st, us, sc = body(p, st, us, data_i, lr, t, rng)
+                ti = t0 + i
+                rng = jax.random.fold_in(key, ti)
+                p, st, us, sc = body(p, st, us, data_i, lr,
+                                     ti.astype(jnp.float32), rng)
                 return (p, st, us), sc
 
             (params, states, upd_state), scores = jax.lax.scan(
                 scan_body, (params, states, upd_state),
-                (data_stack, lrs, jnp.arange(K, dtype=jnp.float32)))
+                (data_stack, lrs, jnp.arange(K, dtype=jnp.uint32)))
             return params, states, upd_state, scores[-1]
 
         backend = jax.default_backend()
@@ -859,7 +865,7 @@ class MultiLayerNetwork(NetworkBase):
              for i in range(K)], jnp.float32)
         params, states, upd, last = fn(
             self.params_list, self.state_list, self.upd_state, data, lrs,
-            jnp.asarray(float(self.iteration)))
+            jnp.asarray(self.iteration, jnp.uint32))
         self.params_list = params
         self.upd_state = upd
         self.state_list = states
@@ -898,11 +904,11 @@ class MultiLayerNetwork(NetworkBase):
                 None if a is None else a[b] for a in data_stack)
 
             def run_seg(p, st, us, data_b, i_seg, j):
-                t = t0 + jnp.asarray(j, t0.dtype)
-                rng = jax.random.fold_in(key, jnp.asarray(t, jnp.uint32))
+                ti = t0 + jnp.asarray(j, t0.dtype)
+                rng = jax.random.fold_in(key, ti)
                 x, y, fm, lm = data_b
                 return body(p, st, us, seg_data(x, y, fm, lm, i_seg),
-                            lrs[j], t, rng)
+                            lrs[j], ti.astype(jnp.float32), rng)
 
             # batch 0 / segment 0 inline: bootstraps the carry structure
             data0 = pick(0)
@@ -963,7 +969,7 @@ class MultiLayerNetwork(NetworkBase):
              for j in range(K * n_seg)], jnp.float32)
         params, states, upd, last = fn(
             self.params_list, states, self.upd_state, data, lrs,
-            jnp.asarray(float(self.iteration)), None)
+            jnp.asarray(self.iteration, jnp.uint32), None)
         self.params_list = params
         self.upd_state = upd
         self._score = last

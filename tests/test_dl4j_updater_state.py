@@ -1,0 +1,206 @@
+"""updaterState.bin round-trip (modelimport/dl4j.py): a model exported
+mid-training and re-imported must RESUME — the next optimizer step must
+produce exactly the params an uninterrupted run produces, which requires
+the optimizer moments (Adam m/v, Nesterov velocity, ...), the iteration
+counter (Adam bias correction + lr schedules), and the training
+hyperparameters to survive the zip.
+
+Reference contract: ModelSerializer.writeModel saveUpdater
+(ModelSerializer.java:107-119) / restoreMultiLayerNetwork(file,
+loadUpdater) (:148); state-view layout per BaseMultiLayerUpdater's
+UpdaterBlocks (BaseMultiLayerUpdater.java:63-104)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.modelimport.dl4j import (
+    _UPDATER_COMPONENTS,
+    export_dl4j_graph,
+    export_dl4j_zip,
+    import_dl4j_computation_graph,
+    import_dl4j_multilayer,
+    restore_updater_state,
+    updater_state_to_flat,
+)
+from deeplearning4j_tpu.nn.compgraph import ComputationGraph
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import (
+    BatchNormalization,
+    DenseLayer,
+    GravesLSTM,
+    OutputLayer,
+    RnnOutputLayer,
+)
+from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+def _cls_data(n=32, nin=6, k=4, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, nin)).astype(np.float32)
+    y = np.zeros((n, k), np.float32)
+    y[np.arange(n), rng.integers(0, k, n)] = 1.0
+    return x, y
+
+
+def _mlp_net(updater="adam", seed=5):
+    conf = (NeuralNetConfiguration.builder().seed(seed)
+            .updater(updater).learning_rate(0.05)
+            .weight_init("xavier").list()
+            .layer(DenseLayer(n_out=9, activation="tanh"))
+            .layer(BatchNormalization())
+            .layer(OutputLayer(n_out=4, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(6)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _max_param_diff(a, b):
+    return max(
+        float(np.max(np.abs(np.asarray(pa[k]) - np.asarray(pb[k]))))
+        for pa, pb in zip(a.params_list, b.params_list) for k in pa
+    )
+
+
+@pytest.mark.parametrize("updater", ["adam", "nesterovs", "rmsprop",
+                                     "adagrad", "adamax", "adadelta"])
+def test_resume_matches_uninterrupted(tmp_path, updater):
+    """export mid-training -> import -> one more step == uninterrupted."""
+    x, y = _cls_data()
+    net = _mlp_net(updater)
+    net.fit(x, y, batch_size=16, epochs=2, async_prefetch=False)
+
+    path = str(tmp_path / "mid.zip")
+    export_dl4j_zip(net, path)
+    back = import_dl4j_multilayer(path)
+    assert back.iteration == net.iteration
+    assert back.net_conf.updater == updater
+
+    # the moments made the trip exactly
+    a = updater_state_to_flat(net)
+    b = updater_state_to_flat(back)
+    np.testing.assert_allclose(a, b, atol=0, rtol=0)
+
+    # one more epoch on both: identical trajectories
+    net.fit(x, y, batch_size=16, epochs=1, async_prefetch=False)
+    back.fit(x, y, batch_size=16, epochs=1, async_prefetch=False)
+    assert _max_param_diff(net, back) < 1e-6
+
+
+def test_cold_updater_diverges(tmp_path):
+    """Sanity: WITHOUT the updater state the resumed trajectory differs —
+    proves the test above actually exercises the moments."""
+    x, y = _cls_data()
+    net = _mlp_net("adam")
+    net.fit(x, y, batch_size=16, epochs=2, async_prefetch=False)
+    path = str(tmp_path / "mid.zip")
+    export_dl4j_zip(net, path)
+    cold = import_dl4j_multilayer(path, load_updater=False)
+    net.fit(x, y, batch_size=16, epochs=1, async_prefetch=False)
+    cold.fit(x, y, batch_size=16, epochs=1, async_prefetch=False)
+    assert _max_param_diff(net, cold) > 1e-6
+
+
+def test_graves_lstm_state_layout_round_trip(tmp_path):
+    """Gate-permuted + peephole-packed moment layout survives the trip."""
+    conf = (NeuralNetConfiguration.builder().seed(3)
+            .updater("adam").learning_rate(0.02).list()
+            .layer(GravesLSTM(n_out=7, activation="tanh"))
+            .layer(RnnOutputLayer(n_out=2, activation="softmax",
+                                  loss="mcxent"))
+            .set_input_type(InputType.recurrent(3)).build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((16, 8, 3)).astype(np.float32)
+    yy = np.zeros((16, 8, 2), np.float32)
+    yy[..., 0] = 1.0
+    net.fit(x, yy, batch_size=16, epochs=2, async_prefetch=False)
+
+    path = str(tmp_path / "lstm.zip")
+    export_dl4j_zip(net, path)
+    back = import_dl4j_multilayer(path)
+    np.testing.assert_allclose(updater_state_to_flat(net),
+                               updater_state_to_flat(back), atol=0, rtol=0)
+    net.fit(x, yy, batch_size=16, epochs=1, async_prefetch=False)
+    back.fit(x, yy, batch_size=16, epochs=1, async_prefetch=False)
+    assert _max_param_diff(net, back) < 1e-6
+
+
+def test_state_view_halves_are_m_then_v():
+    """Pin the nd4j block layout: for a one-block Adam net, the first half
+    of the view is ALL m (in flat param order), the second ALL v."""
+    conf = (NeuralNetConfiguration.builder().seed(1)
+            .updater("adam").learning_rate(0.05).list()
+            .layer(DenseLayer(n_out=5, activation="tanh"))
+            .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(3)).build())
+    net = MultiLayerNetwork(conf).init()
+    x, y = _cls_data(16, 3, 2)
+    net.fit(x, y, batch_size=16, epochs=1, async_prefetch=False)
+    flat = updater_state_to_flat(net)
+    n = net.num_params()
+    assert flat.size == 2 * n
+    m0 = np.asarray(net.upd_state[0]["W"]["m"]).reshape(-1, order="F")
+    v0 = np.asarray(net.upd_state[0]["W"]["v"]).reshape(-1, order="F")
+    np.testing.assert_allclose(flat[: m0.size], m0)
+    np.testing.assert_allclose(flat[n: n + v0.size], v0)
+
+
+def test_bn_mean_var_split_blocks():
+    """BN running mean/var are NONE-updater params in DL4J: they carry no
+    state but break block contiguity, so the layers before and after BN
+    form separate [m|v] blocks rather than one."""
+    net = _mlp_net("adam")
+    x, y = _cls_data()
+    net.fit(x, y, batch_size=32, epochs=1, async_prefetch=False)
+    flat = updater_state_to_flat(net)
+    sizes = [sum(int(np.prod(np.asarray(v).shape)) for v in p.values())
+             for p in net.params_list]
+    assert flat.size == 2 * sum(sizes)
+    # block 1 = dense W+b + bn gamma+beta; its m-half must START with
+    # dense W's m and the v-half with dense W's v
+    blk1 = sizes[0] + sizes[1]
+    mW = np.asarray(net.upd_state[0]["W"]["m"]).reshape(-1, order="F")
+    vW = np.asarray(net.upd_state[0]["W"]["v"]).reshape(-1, order="F")
+    np.testing.assert_allclose(flat[: mW.size], mW)
+    np.testing.assert_allclose(flat[blk1: blk1 + vW.size], vW)
+    # block 2 = output W+b, its own [m|v]
+    mW2 = np.asarray(net.upd_state[2]["W"]["m"]).reshape(-1, order="F")
+    np.testing.assert_allclose(flat[2 * blk1: 2 * blk1 + mW2.size], mW2)
+
+
+def test_graph_resume_matches_uninterrupted(tmp_path):
+    conf = (NeuralNetConfiguration.builder().seed(9)
+            .updater("adam").learning_rate(0.03)
+            .graph_builder().add_inputs("in")
+            .add_layer("d", DenseLayer(n_out=8, activation="relu"), "in")
+            .add_layer("out", OutputLayer(n_out=4, activation="softmax",
+                                          loss="mcxent"), "d")
+            .set_outputs("out")
+            .set_input_types(InputType.feed_forward(6))
+            .build())
+    net = ComputationGraph(conf).init()
+    x, y = _cls_data()
+    net.fit(x, y, batch_size=16, epochs=2, async_prefetch=False)
+    path = str(tmp_path / "graph.zip")
+    export_dl4j_graph(net, path)
+    back = import_dl4j_computation_graph(path)
+    assert back.iteration == net.iteration
+    net.fit(x, y, batch_size=16, epochs=1, async_prefetch=False)
+    back.fit(x, y, batch_size=16, epochs=1, async_prefetch=False)
+    assert _max_param_diff(net, back) < 1e-6
+
+
+def test_stateless_updater_writes_no_entry(tmp_path):
+    net = _mlp_net("sgd")
+    x, y = _cls_data()
+    net.fit(x, y, batch_size=32, epochs=1, async_prefetch=False)
+    assert updater_state_to_flat(net).size == 0
+    path = str(tmp_path / "sgd.zip")
+    export_dl4j_zip(net, path)
+    import zipfile
+
+    with zipfile.ZipFile(path) as zf:
+        assert "updaterState.bin" not in zf.namelist()
+    back = import_dl4j_multilayer(path)
+    np.testing.assert_allclose(np.asarray(back.output(x)),
+                               np.asarray(net.output(x)), atol=1e-6)

@@ -79,10 +79,9 @@ def test_fused_std_matches_loop_exact_chunks():
 
 
 def test_fused_std_ragged_tail_falls_back():
-    # 96 examples / batch 20 -> 4 full + 1 ragged batch of 16: the chunker
-    # must flush [20,20,20,20] unfused (signature break before the ragged
-    # batch leaves a 4-chunk... actually 4 x 20 = one fused chunk) + the
-    # 16-batch per-step. Either way: same trajectory, nothing dropped.
+    # 96 examples / batch 20 -> 4 full batches (one fused K=4 chunk) + 1
+    # ragged batch of 16 whose signature break sends it down the per-step
+    # path: same trajectory as the loop, nothing dropped.
     x, y = _cls_data(96)
     loop, fused = _pair(_mlp_conf, 4)
     for net in (loop, fused):
