@@ -101,7 +101,11 @@ def bench_resnet50(batch=128, steps=8, image_size=224, classes=1000):
         batch, steps, image_size, classes = 8, 4, 64, 10
     conf = resnet50_conf(num_classes=classes, image_size=image_size,
                          precision="bf16" if on_tpu else "f32")
-    net = ComputationGraph(conf).init().set_fused_steps(4)
+    # NO fused multi-batch dispatch here: profiled 98.2 vs 48.8 ms/step
+    # device time (PROFILE_resnet50.md) — the scan-carried params defeat
+    # XLA's layout/fusion choices on this compute-bound model, while
+    # dispatch overhead (the thing fusing removes) is ~5ms/step noise
+    net = ComputationGraph(conf).init()
     rng = np.random.default_rng(0)
     x = rng.random((batch, image_size, image_size, 3), np.float32)
     ds = _device_dataset(x, _onehot(rng, batch, classes))

@@ -184,6 +184,22 @@ def cmd_ui_server(args) -> int:
     return 0
 
 
+def cmd_report(args) -> int:
+    """Standalone HTML training report from a stats file — the
+    ui-components path: no server, one self-contained artifact
+    (ui/report.py)."""
+    from deeplearning4j_tpu.ui import FileStatsStorage
+    from deeplearning4j_tpu.ui.report import write_training_report
+
+    storage = FileStatsStorage(args.stats_file)
+    out = write_training_report(storage, args.output,
+                                session_id=args.session,
+                                title=args.title)
+    print(f"wrote {out} ({len(storage.list_session_ids())} sessions "
+          f"in {args.stats_file})")
+    return 0
+
+
 def main(argv=None) -> int:
     # honor JAX_PLATFORMS even when a sitecustomize imported jax before
     # this process's env was consulted (config update beats env once the
@@ -231,6 +247,15 @@ def main(argv=None) -> int:
     u.add_argument("--stats-file", required=True)
     u.add_argument("--port", type=int, default=9090)
     u.set_defaults(fn=cmd_ui_server)
+
+    r = sub.add_parser(
+        "report", help="standalone self-contained HTML training report")
+    r.add_argument("--stats-file", required=True)
+    r.add_argument("--output", required=True)
+    r.add_argument("--session", default=None,
+                   help="session id (default: newest)")
+    r.add_argument("--title", default="training report")
+    r.set_defaults(fn=cmd_report)
 
     args = ap.parse_args(argv)
     return args.fn(args)

@@ -7,7 +7,12 @@ Capability map:
   into the file store — mechanism, not engine, is the capability)
 - compact wire codec (ui/codec.py)  <- SBE-generated codecs (ui/stats/sbe/)
 - dashboard server (ui/server.py)   <- PlayUIServer + TrainModule routes
-  (/train/overview, /train/model, /train/system) + RemoteReceiverModule
+  (/train/overview, /train/model, /train/flow, /train/system) +
+  RemoteReceiverModule
+- report DSL (ui/components.py)     <- deeplearning4j-ui-components'
+  chart/table/text Component JSON + standalone rendering
+- standalone report (ui/report.py)  <- ui-components report path + the
+  FlowListenerModule layer-graph view, server-free HTML artifact
 """
 
 from deeplearning4j_tpu.ui.stats import (ConvolutionalIterationListener,
@@ -19,6 +24,21 @@ from deeplearning4j_tpu.ui.storage import (
     StatsStorage,
 )
 from deeplearning4j_tpu.ui.server import UIServer
+from deeplearning4j_tpu.ui.components import (
+    ChartHistogram,
+    ChartLine,
+    ChartScatter,
+    Component,
+    ComponentDiv,
+    ComponentTable,
+    ComponentText,
+    render_page,
+)
+from deeplearning4j_tpu.ui.report import (
+    FlowGraph,
+    render_training_report,
+    write_training_report,
+)
 
 __all__ = [
     "ConvolutionalIterationListener",
@@ -28,4 +48,15 @@ __all__ = [
     "FileStatsStorage",
     "RemoteUIStatsStorageRouter",
     "UIServer",
+    "Component",
+    "ComponentText",
+    "ComponentTable",
+    "ComponentDiv",
+    "ChartLine",
+    "ChartHistogram",
+    "ChartScatter",
+    "FlowGraph",
+    "render_page",
+    "render_training_report",
+    "write_training_report",
 ]

@@ -19,6 +19,7 @@ from typing import Optional
 from urllib.parse import urlparse
 
 from deeplearning4j_tpu.ui.codec import decode_record
+from deeplearning4j_tpu.ui.stats import split_stat_key
 from deeplearning4j_tpu.ui.storage import StatsStorage
 from deeplearning4j_tpu.utils.jsonhttp import (
     JsonHttpServer,
@@ -40,6 +41,7 @@ _PAGE = """<!doctype html>
 </style></head>
 <body>
 <nav><a href="/train/overview">overview</a><a href="/train/model">model</a>
+<a href="/train/flow">flow</a>
 <a href="/train/system">system</a><a href="/train/histogram">histogram</a>
 <a href="/train/activations">activations</a><a href="/tsne">tsne</a></nav>
 <h1>dl4j-tpu training — {title}</h1>
@@ -117,6 +119,8 @@ async function refresh() {{
         }}
       }}), 0);
     }}
+  }} else if (VIEW == "flow") {{
+    html += `<div class="chart">${{d.svg || "(no graph yet)"}}</div>`;
   }} else if (VIEW == "tsne") {{
     const W = 760, H = 560;
     let pts = "";
@@ -256,9 +260,10 @@ class UIServer:
                 for u in ups:
                     g = u.get(group) or {}
                     for k, v in g.items():
-                        if k.startswith(f"{li}_"):
+                        kli, pname = split_stat_key(k)
+                        if kli == str(li):
                             series.setdefault(
-                                f"{label} |{k[len(str(li)) + 1:]}|", []
+                                f"{label} |{pname}|", []
                             ).append([u["iteration"], v])
             layers.append({**meta, "series": series})
         return {"session": session, "layers": layers}
@@ -282,6 +287,7 @@ class UIServer:
                  "/train/model": "model", "/train/system": "system",
                  "/train/histogram": "histogram",
                  "/train/activations": "activations",
+                 "/train/flow": "flow",
                  "/tsne": "tsne", "/train/tsne": "tsne"}
         if path in pages:
             view = pages[path]
@@ -296,6 +302,23 @@ class UIServer:
             return json_response(self._tsne)
         if path == "/train/model/data":
             return json_response(self._model_data(session))
+        if path == "/train/flow/data":
+            # flow view (reference: FlowListenerModule): the model DAG
+            # rendered server-side by the report DSL's FlowGraph with
+            # per-layer latest stats overlaid
+            from deeplearning4j_tpu.ui.report import (
+                FlowGraph,
+                _layer_stats_latest,
+            )
+
+            static = (self.storage.get_static_info(session) or {}
+                      ) if session else {}
+            ups = self._score_updates(session)
+            graph = static.get("graph") or {}
+            svg = (FlowGraph(graph, _layer_stats_latest(ups, static))
+                   .render_html() if graph else None)
+            return json_response({"session": session, "graph": graph,
+                                  "svg": svg})
         if path == "/train/model/graph":
             st = (self.storage.get_static_info(session) or {}
                   ) if session else {}

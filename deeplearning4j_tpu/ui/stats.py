@@ -44,6 +44,48 @@ def _device_memory_stats() -> dict:
     return out
 
 
+def split_stat_key(key: str):
+    """Decode the '{layer_index}_{param_name}' keys StatsListener emits in
+    grad_mm/update_mm/param_mm/hists records — the ONE place the format is
+    known (consumers: ui/server.py, ui/report.py)."""
+    li, _, pname = key.partition("_")
+    return li, pname
+
+
+def model_graph(model) -> dict:
+    """Topology for the flow view (reference: FlowListenerModule's
+    layer-graph payload): {nodes: [{id, label, layer_index?}], edges:
+    [[src, dst], ...]}. ComputationGraphs expose their DAG; a
+    MultiLayerNetwork is the input->layer0->...->layerN chain."""
+    conf = getattr(model, "conf", None)
+    confs = model._ordered_layer_confs()
+    if hasattr(conf, "vertex_inputs"):  # ComputationGraph
+        pidx = getattr(model, "_pidx", {})
+        nodes = [{"id": n, "label": n} for n in conf.inputs]
+        for name, v in conf.vertices.items():
+            layer = getattr(v, "layer", None)
+            nodes.append({
+                "id": name,
+                "label": f"{name}\n{type(layer or v).__name__}",
+                **({"layer_index": pidx[name]} if name in pidx else {}),
+            })
+        edges = [[src, name]
+                 for name, ins in conf.vertex_inputs.items()
+                 for src in ins]
+        return {"nodes": nodes, "edges": edges,
+                "outputs": list(conf.outputs)}
+    nodes = [{"id": "input", "label": "input"}]
+    edges = []
+    prev = "input"
+    for i, c in enumerate(confs):
+        nid = f"layer{i}"
+        nodes.append({"id": nid, "label": f"{i}: {type(c).__name__}",
+                      "layer_index": i})
+        edges.append([prev, nid])
+        prev = nid
+    return {"nodes": nodes, "edges": edges, "outputs": [prev]}
+
+
 class StatsListener(IterationListener):
     """Routes per-iteration stats to a StatsStorageRouter.
 
@@ -99,6 +141,7 @@ class StatsListener(IterationListener):
             "start_time": time.time(),
             "layers": layers,
             "total_params": int(sum(l["n_params"] for l in layers)),
+            "graph": model_graph(model),
         })
         self._sent_static = True
 

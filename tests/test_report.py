@@ -1,0 +1,168 @@
+"""Standalone HTML report + component DSL + flow view (ui/components.py,
+ui/report.py; reference: deeplearning4j-ui-components standalone
+rendering + FlowListenerModule)."""
+
+import json
+import urllib.request
+
+import numpy as np
+
+from deeplearning4j_tpu.nn.conf import (
+    DenseLayer,
+    InputType,
+    NeuralNetConfiguration,
+    OutputLayer,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.ui import (
+    ChartHistogram,
+    ChartLine,
+    Component,
+    ComponentDiv,
+    ComponentTable,
+    ComponentText,
+    FlowGraph,
+    InMemoryStatsStorage,
+    StatsListener,
+    UIServer,
+    render_page,
+    write_training_report,
+)
+from deeplearning4j_tpu.ui.stats import model_graph
+
+
+def _trained_storage(histograms=True):
+    conf = (NeuralNetConfiguration.builder().seed(3)
+            .updater("adam").learning_rate(0.05).list()
+            .layer(DenseLayer(n_out=12, activation="relu"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(6)).build())
+    net = MultiLayerNetwork(conf).init()
+    storage = InMemoryStatsStorage()
+    net.set_collect_stats(True)
+    net.set_listeners(StatsListener(
+        storage, session_id="sess-report",
+        histogram_bins=16 if histograms else 0, histogram_frequency=2))
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((64, 6)).astype(np.float32)
+    y = np.zeros((64, 3), np.float32)
+    y[np.arange(64), rng.integers(0, 3, 64)] = 1.0
+    net.fit(x, y, batch_size=16, epochs=3, async_prefetch=False)
+    return storage
+
+
+# -- component DSL ------------------------------------------------------------
+
+def test_component_json_round_trip():
+    from deeplearning4j_tpu.ui.components import ChartScatter, StyleChart
+
+    custom = StyleChart(width=800, height=300, stroke_color="#000000")
+    comps = [
+        ComponentText("hello", size=15, bold=True),
+        ComponentTable(["a", "b"], [[1, 2], [3, 4]]),
+        ChartLine("scores", {"s": [(0, 1.0), (1, 0.5), (2, 0.25)]}),
+        ChartLine("styled", {"s": [(0, 1.0), (1, 2.0)]}, style=custom),
+        ChartHistogram("w", [0.0, 0.5, 1.0], [3, 7]),
+        ChartHistogram("w2", [0.0, 1.0], [5], style=custom),
+        ChartScatter("pts", [(0.0, 1.0), (2.0, 3.0)], labels=["a", "b"],
+                     style=custom),
+        ComponentDiv([ComponentText("inner")], title="box"),
+        FlowGraph({"nodes": [{"id": "a", "label": "a"},
+                             {"id": "b", "label": "b"}],
+                   "edges": [["a", "b"]]}),
+    ]
+    for c in comps:
+        back = Component.from_json(c.to_json())
+        assert type(back) is type(c)
+        assert back.to_dict() == c.to_dict()
+
+
+def test_render_page_self_contained():
+    html = render_page("t", [
+        ComponentText("<script>alert(1)</script>"),  # must be escaped
+        ChartLine("s", {"a": [(0, 1.0), (1, 2.0)]}),
+    ])
+    assert html.startswith("<!doctype html>")
+    assert "<script>alert(1)</script>" not in html  # XSS-escaped
+    assert "&lt;script&gt;" in html
+    assert "<svg" in html
+    # no external references — fully standalone
+    assert "http://" not in html and "https://" not in html
+    assert "src=" not in html
+
+
+# -- report assembly ----------------------------------------------------------
+
+def test_training_report_artifact(tmp_path):
+    storage = _trained_storage()
+    out = str(tmp_path / "report.html")
+    write_training_report(storage, out, title="run 42")
+    html = open(out).read()
+    assert "run 42" in html
+    assert "score vs iteration" in html
+    assert "<svg" in html
+    assert "per-layer mean magnitudes" in html
+    assert "parameter histograms" in html
+    assert "model flow" in html          # the flow graph section
+    assert "DenseLayer" in html          # layer boxes carry types
+    assert "http" not in html.replace("http-equiv", "")  # standalone
+
+
+def test_report_empty_storage(tmp_path):
+    out = str(tmp_path / "empty.html")
+    write_training_report(InMemoryStatsStorage(), out)
+    assert "no sessions" in open(out).read()
+
+
+# -- model graph + flow route -------------------------------------------------
+
+def test_model_graph_mln_chain():
+    conf = (NeuralNetConfiguration.builder().list()
+            .layer(DenseLayer(n_out=4, activation="relu"))
+            .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(3)).build())
+    g = model_graph(MultiLayerNetwork(conf).init())
+    ids = [n["id"] for n in g["nodes"]]
+    assert ids == ["input", "layer0", "layer1"]
+    assert g["edges"] == [["input", "layer0"], ["layer0", "layer1"]]
+
+
+def test_model_graph_compgraph_dag():
+    from deeplearning4j_tpu.nn.compgraph import ComputationGraph
+    from deeplearning4j_tpu.nn.conf import MergeVertex
+
+    conf = (NeuralNetConfiguration.builder().graph_builder()
+            .add_inputs("in")
+            .add_layer("a", DenseLayer(n_out=4, activation="tanh"), "in")
+            .add_layer("b", DenseLayer(n_out=4, activation="tanh"), "in")
+            .add_vertex("m", MergeVertex(), "a", "b")
+            .add_layer("out", OutputLayer(n_out=2, activation="softmax"),
+                       "m")
+            .set_outputs("out")
+            .set_input_types(InputType.feed_forward(3)).build())
+    g = model_graph(ComputationGraph(conf).init())
+    assert ["in", "m"] in g["edges"] or ["a", "m"] in g["edges"]
+    assert {"a", "b", "m", "out"} <= {n["id"] for n in g["nodes"]}
+    # layer vertices carry the param-list index for stats overlay
+    layer_nodes = {n["id"]: n for n in g["nodes"] if "layer_index" in n}
+    assert {"a", "b", "out"} <= set(layer_nodes)
+
+
+def test_flow_route_serves_graph_svg():
+    storage = _trained_storage(histograms=False)
+    server = UIServer(storage, port=0)
+    port = server.start()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/train/flow/data") as r:
+            d = json.loads(r.read())
+        assert d["graph"]["edges"] == [["input", "layer0"],
+                                       ["layer0", "layer1"]]
+        assert d["svg"] and "<svg" in d["svg"]
+        assert "DenseLayer" in d["svg"]
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/train/flow") as r:
+            page = r.read().decode()
+        assert "flow" in page
+    finally:
+        server.stop()
