@@ -312,11 +312,19 @@ def bench_word2vec(vocab=10_000, n_sents=2_000, sent_len=40, batch=8192,
     sv.build_vocab()
     indexed = sv._index_sentences(sents)
     total_words = sum(int(s.size) for s in indexed)
-    sv.train_indexed(indexed[: max(2, n_sents // 10)])  # warmup/compile
-    t0 = time.perf_counter()
+    # warmup on the FULL corpus: the corpus-resident device path compiles
+    # per corpus-size bucket, so a small-prefix warmup would leave the
+    # full-size compile inside the timed region. Median of 3 timed runs —
+    # the corpus upload rides the tunnel, whose latency varies run to run.
     sv.train_indexed(indexed)
-    float(np.asarray(sv.lookup.syn0[0, 0]))  # sync
-    dt = time.perf_counter() - t0
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        sv.train_indexed(indexed)
+        float(np.asarray(sv.lookup.syn0[0, 0]))  # sync
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    dt = times[1]
     return {
         "value": round(total_words / dt, 1),
         "unit": "words/sec/chip",

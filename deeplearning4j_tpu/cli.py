@@ -188,9 +188,16 @@ def cmd_report(args) -> int:
     """Standalone HTML training report from a stats file — the
     ui-components path: no server, one self-contained artifact
     (ui/report.py)."""
+    import os
+
     from deeplearning4j_tpu.ui import FileStatsStorage
     from deeplearning4j_tpu.ui.report import write_training_report
 
+    if not os.path.exists(args.stats_file):
+        # FileStatsStorage creates missing files — a typo'd path would
+        # silently produce an empty report instead of an error
+        print(f"stats file not found: {args.stats_file}", file=sys.stderr)
+        return 2
     storage = FileStatsStorage(args.stats_file)
     out = write_training_report(storage, args.output,
                                 session_id=args.session,

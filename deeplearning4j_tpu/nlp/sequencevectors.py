@@ -176,6 +176,76 @@ class SequenceVectors:
                 f"unknown elements learning algorithm {mode!r} "
                 "(skipgram | cbow)"
             )
+        if (mode == "skipgram" and conf.negative > 0
+                and not conf.use_hierarchic_softmax):
+            # corpus-resident path: upload 4 bytes/word, generate pairs
+            # ON DEVICE (nlp/devicegen.py) — the host link is the word2vec
+            # bottleneck on remote TPUs (~50 bytes/word of pair batches at
+            # ~2.8 MB/s measured vs one corpus upload)
+            return self._train_corpus_device(indexed)
+        return self._train_batched(indexed)
+
+    def _unigram_dev(self):
+        """Device-resident negative-sampling table, uploaded ONCE per
+        lookup table (it is 4 MB — re-shipping it every train call through
+        a slow host link costs more than a whole epoch). Keyed on the
+        lookup instance: build_vocab creates a fresh lookup, so a vocab
+        rebuild invalidates the cache rather than sampling stale indices."""
+        cached = getattr(self, "_unigram_dev_cache", None)
+        if cached is None or cached[0] is not self.lookup:
+            table = jnp.asarray(self.lookup.unigram_table().astype(np.int32))
+            self._unigram_dev_cache = (self.lookup, table)
+        return self._unigram_dev_cache[1]
+
+    def _train_corpus_device(self, indexed: List[np.ndarray]):
+        import jax
+
+        from deeplearning4j_tpu.nlp.devicegen import (
+            make_corpus_skipgram_step,
+            pack_corpus,
+        )
+
+        conf = self.conf
+        if self._base_key is None:
+            self._base_key = jax.random.PRNGKey(conf.seed ^ 0x5EED)
+        if getattr(self, "_corpus_step", None) is None:
+            self._corpus_step = make_corpus_skipgram_step(
+                negative=conf.negative, window=conf.window,
+                pairs_per_batch=conf.batch_size)
+        step = self._corpus_step
+        unigram_dev = self._unigram_dev()
+        keep = keep_probabilities(self.vocab.counts(), conf.sampling)
+        per_word = conf.window + 1  # E[pairs/word] under the dynamic window
+        total_pairs = float(max(
+            sum(int(s.size) for s in indexed) * conf.epochs
+            * conf.iterations * per_word, 1))
+        syn0 = self.lookup.syn0
+        syn1neg = self.lookup.syn1neg
+        seen = jnp.zeros((), jnp.float32)
+        loss = None
+        self.last_loss = float("nan")
+        for epoch in range(conf.epochs):
+            sents = [subsample(s, keep, self._rng) for s in indexed]
+            corpus = jnp.asarray(pack_corpus(sents, conf.window))
+            for it in range(conf.iterations):
+                syn0, syn1neg, loss, seen = step(
+                    syn0, syn1neg, unigram_dev, corpus,
+                    jnp.float32(conf.learning_rate),
+                    jnp.float32(conf.min_learning_rate),
+                    jnp.float32(total_pairs), seen,
+                    jax.random.fold_in(
+                        self._base_key, epoch * 7919 + it),
+                )
+            if loss is not None:
+                self.last_loss = float(loss)
+            logger.info("epoch %d done, loss %.4f", epoch, self.last_loss)
+        self.lookup.syn0 = syn0
+        self.lookup.syn1neg = syn1neg
+        return None
+
+    def _train_batched(self, indexed: List[np.ndarray]):
+        conf = self.conf
+        mode = conf.elements_learning_algorithm
         plan = BatchPlan(
             batch_size=conf.batch_size,
             context_size=1 if mode == "skipgram" else 2 * conf.window,
@@ -185,7 +255,7 @@ class SequenceVectors:
             skip_h_mask=mode == "skipgram",
         )
         unigram_dev = (
-            jnp.asarray(self.lookup.unigram_table().astype(np.int32))
+            self._unigram_dev()
             if conf.negative > 0 else jnp.zeros((1,), jnp.int32)
         )
         import jax

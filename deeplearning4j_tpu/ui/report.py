@@ -171,12 +171,10 @@ def build_report_components(storage: StatsStorage,
                             ) -> List[Component]:
     """Component tree for one session's training run (newest session when
     not named)."""
-    ids = storage.list_session_ids()
     if session_id is None:
-        if not ids:
+        session_id = storage.latest_session_id()
+        if session_id is None:
             return [ComponentText("no sessions in storage", bold=True)]
-        session_id = max(ids, key=lambda s: (
-            (storage.get_updates(s) or [{}])[-1].get("ts", 0.0)))
     static = storage.get_static_info(session_id) or {}
     ups = [u for u in storage.get_updates(session_id) if "score" in u]
 

@@ -183,21 +183,7 @@ class UIServer:
     # -- data assembly -------------------------------------------------------
 
     def _current_session(self) -> Optional[str]:
-        """Most recently ACTIVE session (latest update/static timestamp),
-        not lexicographic order — random session-id suffixes don't sort
-        by age."""
-        ids = self.storage.list_session_ids()
-        if not ids:
-            return None
-
-        def last_ts(sid):
-            ups = self.storage.get_updates(sid)
-            if ups:
-                return ups[-1].get("ts", 0.0)
-            st = self.storage.get_static_info(sid) or {}
-            return st.get("start_time", 0.0)
-
-        return max(ids, key=last_ts)
+        return self.storage.latest_session_id()
 
     def _score_updates(self, session: Optional[str]) -> list:
         """Training-progress records only — the stream also carries

@@ -48,6 +48,25 @@ class StatsStorage(StatsStorageRouter):
                     since_iteration: int = -1) -> List[dict]:
         raise NotImplementedError
 
+    def latest_session_id(self) -> Optional[str]:
+        """Most recently ACTIVE session — newest update timestamp, falling
+        back to the static start_time for sessions that have not reported
+        an update yet. The ONE definition of "current session" shared by
+        the dashboard (ui/server.py) and the standalone report
+        (ui/report.py); random session-id suffixes don't sort by age."""
+        ids = self.list_session_ids()
+        if not ids:
+            return None
+
+        def last_ts(sid):
+            ups = self.get_updates(sid)
+            if ups:
+                return ups[-1].get("ts", 0.0)
+            st = self.get_static_info(sid) or {}
+            return st.get("start_time", 0.0)
+
+        return max(ids, key=last_ts)
+
     # listener routing (reference: StatsStorageListener)
     def register_listener(self, fn: Callable[[str, dict], None]) -> None:
         if not hasattr(self, "_listeners"):

@@ -1,0 +1,96 @@
+"""Generator for cg_adam_v1.zip / cg_adam_v1_expected.npz — run ONCE and
+commit the outputs; tests load the frozen bytes (the reference's
+regressiontest discipline, RegressionTest080.java: assertions against
+release-era artifacts, never against freshly-built ones).
+
+The zip is hand-assembled in the REFERENCE shape (Jackson WRAPPER_OBJECT
+vertices, networkInputs/vertexInputs names, vertices listed OUT of
+topological order, coefficients.bin in topo+f-order layout, Adam
+updaterState.bin as one [m|v] block) so the fixture pins the parser to
+the wire format, not to this framework's own exporter.
+"""
+
+import io
+import json
+import os
+import zipfile
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def main():
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(HERE)))
+    from deeplearning4j_tpu.modelimport.dl4j import write_nd4j_array
+
+    rng = np.random.default_rng(42)
+    nin, h, classes = 5, 3, 3
+    Wa = rng.standard_normal((nin, h)).astype(np.float32)
+    ba = rng.standard_normal(h).astype(np.float32)
+    Wb = rng.standard_normal((nin, h)).astype(np.float32)
+    bb = rng.standard_normal(h).astype(np.float32)
+    Wo = rng.standard_normal((2 * h, classes)).astype(np.float32)
+    bo = rng.standard_normal(classes).astype(np.float32)
+
+    train = {"updater": "ADAM", "learningRate": 0.01,
+             "adamMeanDecay": 0.9, "adamVarDecay": 0.999, "epsilon": 1e-8}
+    conf = {
+        "networkInputs": ["in"],
+        "networkOutputs": ["out"],
+        # deliberately NOT in topological order
+        "vertices": {
+            "out": {"LayerVertex": {"layerConf": {"layer": {"output": {
+                "nin": 2 * h, "nout": classes, "activationFn": "softmax",
+                "lossFn": "mcxent", **train}}}}},
+            "m": {"MergeVertex": {}},
+            "b": {"LayerVertex": {"layerConf": {"layer": {"dense": {
+                "nin": nin, "nout": h, "activationFn": "tanh",
+                **train}}}}},
+            "a": {"LayerVertex": {"layerConf": {"layer": {"dense": {
+                "nin": nin, "nout": h, "activationFn": "relu",
+                **train}}}}},
+        },
+        "vertexInputs": {"a": ["in"], "b": ["in"], "m": ["a", "b"],
+                         "out": ["m"]},
+        "iterationCount": 7,
+    }
+    # reference flat walk is TOPO order with FIFO-Kahn ascending-vertex-
+    # number tie-breaks; vertex numbers follow JSON listing order
+    # (out=1, m=2, b=3, a=4), so the walk is b, a, out (m has no params)
+    flat = np.concatenate([
+        Wb.reshape(-1, order="F"), bb, Wa.reshape(-1, order="F"), ba,
+        Wo.reshape(-1, order="F"), bo,
+    ])
+    # Adam updater state: ONE block (uniform config, no BN) = [all m | all v]
+    n = flat.size
+    m_state = (rng.standard_normal(n) * 0.01).astype(np.float32)
+    v_state = np.abs(rng.standard_normal(n) * 1e-4).astype(np.float32)
+    upd = np.concatenate([m_state, v_state])
+
+    cbuf, ubuf = io.BytesIO(), io.BytesIO()
+    write_nd4j_array(flat, cbuf)
+    write_nd4j_array(upd, ubuf)
+    zpath = os.path.join(HERE, "cg_adam_v1.zip")
+    with zipfile.ZipFile(zpath, "w") as zf:
+        zf.writestr("configuration.json", json.dumps(conf))
+        zf.writestr("coefficients.bin", cbuf.getvalue())
+        zf.writestr("updaterState.bin", ubuf.getvalue())
+
+    # expected outputs, computed here once with plain numpy
+    x = rng.standard_normal((4, nin)).astype(np.float32)
+    act_a = np.maximum(x @ Wa + ba, 0.0)
+    act_b = np.tanh(x @ Wb + bb)
+    merged = np.concatenate([act_a, act_b], axis=1)
+    logits = merged @ Wo + bo
+    e = np.exp(logits - logits.max(axis=1, keepdims=True))
+    out = e / e.sum(axis=1, keepdims=True)
+    np.savez(os.path.join(HERE, "cg_adam_v1_expected.npz"),
+             x=x, out=out, updater_state=upd, iteration=np.int64(7))
+    print("wrote", zpath)
+
+
+if __name__ == "__main__":
+    main()

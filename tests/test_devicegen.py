@@ -1,0 +1,143 @@
+"""Device-side skip-gram example generation (nlp/devicegen.py): pair
+extraction invariants vs a brute-force oracle, sentence-boundary safety,
+and end-to-end learning through the corpus-resident train path (which
+the skipgram+negative-sampling configuration now uses by default)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nlp.devicegen import (
+    SENTINEL,
+    corpus_pairs_debug,
+    pack_corpus,
+)
+from deeplearning4j_tpu.nlp.sequencevectors import (
+    SequenceVectors,
+    VectorsConfiguration,
+)
+
+
+def test_pack_corpus_gaps_and_padding():
+    sents = [np.array([1, 2, 3]), np.array([], np.int64), np.array([4, 5])]
+    out = pack_corpus(sents, window=3, bucket=16)
+    assert out.size == 16
+    np.testing.assert_array_equal(
+        out[:11],
+        [1, 2, 3, SENTINEL, SENTINEL, SENTINEL, 4, 5,
+         SENTINEL, SENTINEL, SENTINEL])
+    assert (out[11:] == SENTINEL).all()
+
+
+def _brute_pairs(corpus, window):
+    """Oracle: ALL same-sentence (input=context, target=center) pairs
+    within `window` (the superset any dynamic-window draw can emit)."""
+    pairs = set()
+    n = corpus.size
+    for i in range(n):
+        if corpus[i] < 0:
+            continue
+        for d in range(1, window + 1):
+            for j in (i - d, i + d):
+                if 0 <= j < n and corpus[j] >= 0:
+                    pairs.add((int(corpus[j]), int(corpus[i]), d))
+    return pairs
+
+
+def test_device_pairs_subset_of_oracle_and_d1_complete():
+    rng = np.random.default_rng(0)
+    sents = [rng.integers(1, 50, rng.integers(2, 12)).astype(np.int64)
+             for _ in range(8)]
+    window = 4
+    corpus = pack_corpus(sents, window, bucket=64)
+    ins, tgt, valid = corpus_pairs_debug(
+        corpus, window, jax.random.PRNGKey(7))
+    oracle = _brute_pairs(corpus, window)
+    oracle_it = {(a, b) for a, b, _ in oracle}
+
+    n_centers = corpus.size
+    offsets = np.concatenate([np.arange(-window, 0),
+                              np.arange(1, window + 1)])
+    dist = np.abs(np.tile(offsets, n_centers))
+    got = list(zip(ins[valid], tgt[valid]))
+    assert got, "no pairs generated"
+    # every generated pair exists in the oracle (no cross-sentence or
+    # sentinel leakage, correct input/target roles)
+    for pair in got:
+        assert (int(pair[0]), int(pair[1])) in oracle_it
+    # distance-1 pairs are ALWAYS valid (w_eff = window - b >= 1), so the
+    # full oracle set at d=1 must be present
+    d1_got = {(int(a), int(b)) for (a, b), d in
+              zip(zip(ins, tgt), dist) if d == 1}
+    d1_oracle = {(a, b) for a, b, d in oracle if d == 1}
+    # restrict the generated side to valid rows
+    d1_got_valid = {(int(a), int(b)) for (a, b), d, v in
+                    zip(zip(ins, tgt), dist, valid) if d == 1 and v}
+    assert d1_oracle <= d1_got_valid
+
+
+def test_no_pairs_cross_sentence_boundaries():
+    # two sentences of distinct vocab ranges: no mixed pair may appear
+    sents = [np.arange(1, 8), np.arange(100, 108)]
+    window = 5
+    corpus = pack_corpus(sents, window, bucket=64)
+    ins, tgt, valid = corpus_pairs_debug(
+        corpus, window, jax.random.PRNGKey(3))
+    for a, b in zip(ins[valid], tgt[valid]):
+        assert (a < 50) == (b < 50), f"cross-sentence pair {a}->{b}"
+
+
+def _cluster_corpus(n=300, seed=5):
+    """Two disjoint topic clusters (mirrors test_word2vec patterns)."""
+    rng = np.random.default_rng(seed)
+    a = ["apple", "banana", "cherry", "grape"]
+    b = ["cpu", "gpu", "ram", "disk"]
+    sents = []
+    for _ in range(n):
+        pool = a if rng.random() < 0.5 else b
+        sents.append([pool[i] for i in rng.integers(0, len(pool), 6)])
+    return sents, a, b
+
+
+def test_corpus_device_path_learns_clusters():
+    sents, a, b = _cluster_corpus()
+    conf = VectorsConfiguration(
+        layer_size=24, window=3, min_word_frequency=1, epochs=12,
+        negative=5, use_hierarchic_softmax=False, batch_size=1024,
+        learning_rate=0.05, seed=11)
+    sv = SequenceVectors(conf, sents)
+    sv.fit()
+    assert np.isfinite(sv.last_loss)
+    within = sv.similarity(a[0], a[1])
+    across = sv.similarity(a[0], b[0])
+    assert within > across, (within, across)
+
+
+def test_corpus_device_path_is_selected(monkeypatch):
+    """skipgram + negative sampling must route through the corpus path,
+    not the host pair-batch path."""
+    sents, _, _ = _cluster_corpus(50)
+    conf = VectorsConfiguration(
+        layer_size=8, window=2, min_word_frequency=1, epochs=1,
+        negative=3, use_hierarchic_softmax=False, batch_size=256)
+    sv = SequenceVectors(conf, sents)
+    called = {}
+    orig = sv._train_corpus_device
+    monkeypatch.setattr(
+        sv, "_train_corpus_device",
+        lambda idx: called.setdefault("yes", True) or orig(idx))
+    sv.fit()
+    assert called.get("yes")
+
+
+def test_hs_path_still_uses_batched(monkeypatch):
+    sents, _, _ = _cluster_corpus(50)
+    conf = VectorsConfiguration(
+        layer_size=8, window=2, min_word_frequency=1, epochs=1,
+        negative=0, use_hierarchic_softmax=True, batch_size=256)
+    sv = SequenceVectors(conf, sents)
+    monkeypatch.setattr(
+        sv, "_train_corpus_device",
+        lambda idx: (_ for _ in ()).throw(AssertionError("wrong path")))
+    sv.fit()  # must not raise
+    assert np.isfinite(sv.last_loss)

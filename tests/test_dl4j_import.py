@@ -192,52 +192,36 @@ def test_graph_zip_round_trip(tmp_path):
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
 
 
-def test_graph_import_reference_json_shape(tmp_path):
-    """A hand-built configuration.json in the exact Jackson shape
-    (WRAPPER_OBJECT vertices, networkInputs/vertexInputs field names,
-    vertices deliberately listed OUT of topological order) — pins the
-    parser to the reference format rather than to our own exporter."""
-    import io as _io
-    import json as _json
-    import zipfile as _zipfile
-    from deeplearning4j_tpu.modelimport.dl4j import write_nd4j_array
+def test_graph_import_frozen_reference_fixture():
+    """Byte-frozen fixture zip in the exact Jackson shape (WRAPPER_OBJECT
+    vertices, networkInputs/vertexInputs names, vertices deliberately
+    listed OUT of topological order, Adam updaterState.bin) — the
+    reference's regressiontest discipline (RegressionTest080.java loads
+    release-era artifacts) rather than JSON built adjacent to the code
+    under test. Regenerate ONLY with tests/fixtures/make_cg_fixture.py
+    and only for deliberate format-version bumps."""
+    import os as _os
 
-    rng = np.random.default_rng(5)
-    W1 = rng.standard_normal((4, 3)).astype(np.float32)
-    b1 = rng.standard_normal(3).astype(np.float32)
-    W2 = rng.standard_normal((3, 2)).astype(np.float32)
-    b2 = rng.standard_normal(2).astype(np.float32)
-    conf = {
-        "networkInputs": ["in"],
-        "networkOutputs": ["out"],
-        # "out" listed before "h": JSON order is NOT topo order here
-        "vertices": {
-            "out": {"LayerVertex": {"layerConf": {"layer": {"output": {
-                "nin": 3, "nout": 2, "activationFn": "softmax",
-                "lossFn": "mcxent"}}}}},
-            "h": {"LayerVertex": {"layerConf": {"layer": {"dense": {
-                "nin": 4, "nout": 3, "activationFn": "tanh"}}}}},
-        },
-        "vertexInputs": {"out": ["h"], "h": ["in"]},
-    }
-    # reference flat order is topological: h first, then out
-    flat = np.concatenate([W1.reshape(-1, order="F"), b1,
-                           W2.reshape(-1, order="F"), b2])
-    buf = _io.BytesIO()
-    write_nd4j_array(flat, buf)
-    p = tmp_path / "ref_graph.zip"
-    with _zipfile.ZipFile(p, "w") as zf:
-        zf.writestr("configuration.json", _json.dumps(conf))
-        zf.writestr("coefficients.bin", buf.getvalue())
+    from deeplearning4j_tpu.modelimport.dl4j import updater_state_to_flat
 
-    net = import_dl4j_computation_graph(str(p))
-    x = rng.standard_normal((6, 4)).astype(np.float32)
-    h = np.tanh(x @ W1 + b1)
-    logits = h @ W2 + b2
-    e = np.exp(logits - logits.max(axis=1, keepdims=True))
-    want = e / e.sum(axis=1, keepdims=True)
-    np.testing.assert_allclose(np.asarray(net.output(x)), want,
-                               rtol=1e-5, atol=1e-6)
+    fixtures = _os.path.join(_os.path.dirname(_os.path.abspath(__file__)),
+                             "fixtures")
+    path = _os.path.join(fixtures, "cg_adam_v1.zip")
+    expected = np.load(_os.path.join(fixtures, "cg_adam_v1_expected.npz"))
+
+    net = import_dl4j_computation_graph(path)
+    np.testing.assert_allclose(np.asarray(net.output(expected["x"])),
+                               expected["out"], rtol=1e-5, atol=1e-6)
+    # resume state: iteration counter + the Adam [m|v] block view survive
+    assert net.iteration == int(expected["iteration"])
+    assert net.net_conf.updater == "adam"
+    # flat-walk order: FIFO Kahn over JSON-order vertex numbers -> b, a, out
+    np.testing.assert_allclose(
+        updater_state_to_flat(
+            net, indexed_layer_confs=[
+                (net._pidx[n], net.conf.vertices[n].layer)
+                for n in ("b", "a", "out")]),
+        expected["updater_state"], atol=0, rtol=0)
 
 
 def test_dl4j_topo_matches_reference_kahn():
