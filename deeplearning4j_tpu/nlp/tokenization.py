@@ -3,14 +3,26 @@
 Analog of the reference's text/tokenization/ (TokenizerFactory SPI,
 DefaultTokenizerFactory, NGramTokenizerFactory, CommonPreprocessor —
 deeplearning4j-nlp/.../text/tokenization/tokenizerfactory/). Language
-plugins (Japanese Kuromoji, Korean) are out of scope for the core; the SPI
-accepts any callable factory so they can be added the same way.
+plugins ride the same SPI: CJKTokenizerFactory below (dictionary-free
+char-class runs + bigrams) and the Japanese lattice segmenter in
+nlp/japanese.py (the deeplearning4j-nlp-japanese slot); see README "CJK
+tokenization" for the scope rationale.
 """
 
 from __future__ import annotations
 
 import re
 from typing import Callable, List, Optional
+
+# single source of the CJK/word character classes: the run tokenizer and
+# the Japanese lattice's per-char classifier must never drift apart
+CJK_CHAR_RANGES = (
+    ("han", "㐀-䶿一-鿿豈-﫿"),
+    ("hiragana", "぀-ゟ"),
+    ("katakana", "゠-ヿㇰ-ㇿ"),
+    ("hangul", "가-힯ᄀ-ᇿ"),
+    ("word", "A-Za-z0-9_"),
+)
 
 
 class TokenPreProcess:
@@ -106,12 +118,9 @@ class CJKTokenizerFactory(TokenizerFactory):
     ``bigrams=False`` keeps whole runs (closer to word2vec preprocessing
     for pre-segmented corpora)."""
 
-    _CLASSES = (
-        ("han", re.compile(r"[㐀-䶿一-鿿豈-﫿]+")),
-        ("hiragana", re.compile(r"[぀-ゟ]+")),
-        ("katakana", re.compile(r"[゠-ヿㇰ-ㇿ]+")),
-        ("hangul", re.compile(r"[가-힯ᄀ-ᇿ]+")),
-        ("word", re.compile(r"[A-Za-z0-9_]+")),
+    _CLASSES = tuple(
+        (name, re.compile(f"[{body}]+"))
+        for name, body in CJK_CHAR_RANGES
     )
 
     def __init__(self, bigrams: bool = True):

@@ -1,0 +1,37 @@
+"""Meta-checks that documentation claims stay true.
+
+Round-4 verdict finding: a docstring cited an equivalence test that did
+not exist ("manufactured verification"). This sweep greps every source
+docstring/comment for `tests/<file>.py` citations and fails if any cited
+file is missing — a claim of test coverage must point at a real test."""
+
+import os
+import re
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PAT = re.compile(r"tests/([A-Za-z0-9_]+\.py)")
+
+
+def _source_files():
+    for root, dirs, files in os.walk(os.path.join(REPO, "deeplearning4j_tpu")):
+        dirs[:] = [d for d in dirs if not d.startswith("__pycache__")]
+        for f in files:
+            if f.endswith(".py"):
+                yield os.path.join(root, f)
+    for extra in ("bench.py", "__graft_entry__.py"):
+        p = os.path.join(REPO, extra)
+        if os.path.exists(p):
+            yield p
+
+
+def test_cited_test_files_exist():
+    missing = []
+    for path in _source_files():
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+        for m in PAT.finditer(text):
+            cited = os.path.join(REPO, "tests", m.group(1))
+            if not os.path.exists(cited):
+                missing.append(f"{os.path.relpath(path, REPO)} cites "
+                               f"{m.group(0)}")
+    assert not missing, "dangling test citations:\n" + "\n".join(missing)

@@ -1,0 +1,65 @@
+"""Japanese lattice segmenter (nlp/japanese.py) — morphological
+segmentation on the TokenizerFactory SPI (the deeplearning4j-nlp-japanese
+slot; reference bundles a Kuromoji fork, SURVEY aux: CJK tokenization)."""
+
+from deeplearning4j_tpu.nlp.japanese import (
+    JapaneseTokenizerFactory,
+    segment,
+)
+from deeplearning4j_tpu.nlp.tokenization import CJKTokenizerFactory
+
+
+def test_particles_and_dictionary_words_recovered():
+    assert segment("私は東京に行きます") == \
+        ["私", "は", "東京", "に", "行き", "ます"]
+    assert segment("猫が水を飲んだ") == ["猫", "が", "水", "を", "飲んだ"]
+    assert segment("今日はとても暑いですね") == \
+        ["今日", "は", "とても", "暑い", "です", "ね"]
+
+
+def test_punctuation_and_whitespace_are_boundaries():
+    toks = segment("明日、学校で勉強します。")
+    assert toks == ["明日", "学校", "で", "勉強", "します"]
+
+
+def test_unknown_runs_stay_whole_by_class():
+    # katakana loanword + latin word are not in the lexicon: whole runs
+    toks = segment("カタカナとAlphabetと漢字")
+    assert "カタカナ" in toks and "Alphabet" in toks and "漢字" in toks
+
+
+def test_unknown_kanji_compound_does_not_swallow_particles():
+    # 量子力学 is out-of-lexicon; は/の must still split off
+    toks = segment("量子力学の本は難しい")
+    assert "の" in toks and "は" in toks and "難しい" in toks
+    assert "量子力学" in toks
+
+
+def test_factory_spi_and_custom_lexicon():
+    f = JapaneseTokenizerFactory(lexicon={"量子力学": 3.0})
+    toks = f.create("量子力学は難しい").get_tokens()
+    assert toks == ["量子力学", "は", "難しい"]
+
+
+def test_beats_bigram_fallback_on_word_boundaries():
+    """The lattice recovers real word units where the bigram fallback
+    emits overlapping han pairs that cross word boundaries."""
+    text = "東京大学の学生"
+    lattice = segment(text)
+    bigrams = CJKTokenizerFactory().create(text).get_tokens()
+    assert "東京" in lattice and "学生" in lattice
+    assert "京大" in bigrams       # boundary-crossing bigram artifact
+    assert "京大" not in lattice   # the lattice never crosses 東京|大学
+
+
+def test_unknown_hiragana_run_does_not_swallow_particle():
+    # out-of-lexicon hiragana word + particle: the prefix unknown-edges
+    # must expose the が boundary instead of fusing ぬるぽが
+    toks = segment("ぬるぽが好き")
+    assert toks[:2] == ["ぬるぽ", "が"]
+    assert "好き" in toks
+
+
+def test_empty_and_nonjapanese():
+    assert segment("") == []
+    assert segment("hello world") == ["hello", "world"]
