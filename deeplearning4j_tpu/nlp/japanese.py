@@ -44,7 +44,7 @@ _PARTICLES = ["は", "が", "を", "に", "で", "と", "も", "の", "へ", "
 _COPULA = ["です", "でした", "だ", "だった", "である", "ます", "ました",
            "ません", "ましょう", "たい", "ない", "なかった", "れる",
            "られる", "せる", "させる", "て", "た", "ている", "ていた",
-           "ます", "う", "よう"]
+           "う", "よう"]
 _WORDS = [
     # pronouns / people
     "私", "僕", "君", "彼", "彼女", "あなた", "誰", "人", "皆", "友達",

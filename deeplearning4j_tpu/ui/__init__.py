@@ -2,9 +2,10 @@
 
 Capability map:
 - StatsListener (ui/stats.py)       <- BaseStatsListener.java:51,103-124
-- storage SPI + impls (ui/storage.py) <- api/storage/StatsStorage.java,
-  InMemoryStatsStorage / FileStatsStorage (MapDB/sqlite variants collapse
-  into the file store — mechanism, not engine, is the capability)
+- storage SPI + impls (ui/storage.py) <- api/storage/StatsStorage.java:
+  InMemoryStatsStorage / FileStatsStorage (append-only log) /
+  SqliteStatsStorage (indexed durable store — the MapDBStatsStorage /
+  J7FileStatsStorage analog)
 - compact wire codec (ui/codec.py)  <- SBE-generated codecs (ui/stats/sbe/)
 - dashboard server (ui/server.py)   <- PlayUIServer + TrainModule routes
   (/train/overview, /train/model, /train/flow, /train/system) +
@@ -21,6 +22,7 @@ from deeplearning4j_tpu.ui.storage import (
     FileStatsStorage,
     InMemoryStatsStorage,
     RemoteUIStatsStorageRouter,
+    SqliteStatsStorage,
     StatsStorage,
 )
 from deeplearning4j_tpu.ui.server import UIServer
@@ -46,6 +48,7 @@ __all__ = [
     "StatsStorage",
     "InMemoryStatsStorage",
     "FileStatsStorage",
+    "SqliteStatsStorage",
     "RemoteUIStatsStorageRouter",
     "UIServer",
     "Component",

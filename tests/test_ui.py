@@ -202,3 +202,30 @@ def test_histogram_tsne_activation_modules():
             assert "dl4j-tpu training" in html
     finally:
         server.stop()
+
+
+def test_sqlite_stats_storage(tmp_path):
+    """Indexed durable storage (MapDB/J7FileStatsStorage analog): SPI
+    parity with the file store + since_iteration as a range query +
+    cold reopen."""
+    from deeplearning4j_tpu.ui import SqliteStatsStorage
+
+    path = str(tmp_path / "stats.sqlite")
+    s = SqliteStatsStorage(path)
+    s.put_static_info("sess", {"model_class": "M", "total_params": 3})
+    for i in range(20):
+        s.put_update("sess", {"iteration": i, "ts": float(i),
+                              "score": 1.0 / (i + 1)})
+    assert s.list_session_ids() == ["sess"]
+    assert s.get_static_info("sess")["total_params"] == 3
+    ups = s.get_updates("sess")
+    assert [u["iteration"] for u in ups] == list(range(20))
+    tail = s.get_updates("sess", since_iteration=15)
+    assert [u["iteration"] for u in tail] == [16, 17, 18, 19]
+    assert s.latest_session_id() == "sess"
+    s.close()
+
+    cold = SqliteStatsStorage(path)  # reopen: data survived
+    assert len(cold.get_updates("sess")) == 20
+    assert abs(cold.get_updates("sess")[3]["score"] - 0.25) < 1e-9
+    cold.close()
