@@ -118,7 +118,8 @@ def bench_resnet50(batch=128, steps=8, image_size=224, classes=1000):
     rng = np.random.default_rng(0)
     x = rng.random((batch, image_size, image_size, 3), np.float32)
     ds = _device_dataset(x, _onehot(rng, batch, classes))
-    dt, n_steps = _time_fit(net, lambda k: ExistingDataSetIterator([ds] * k), steps)
+    dt, n_steps = _time_fit(net, lambda k: ExistingDataSetIterator([ds] * k), steps,
+                            reps=3 if on_tpu else 1)
     ips = batch * n_steps / dt
     fwd = graph_forward_flops(conf)
     step_flops = train_step_flops(fwd, batch)
@@ -145,7 +146,8 @@ def bench_lenet(batch=512, steps=30):
     rng = np.random.default_rng(0)
     ds = _device_dataset(rng.random((batch, 784), np.float32),
                          _onehot(rng, batch, 10))
-    dt, n_steps = _time_fit(net, lambda k: ExistingDataSetIterator([ds] * k), steps)
+    dt, n_steps = _time_fit(net, lambda k: ExistingDataSetIterator([ds] * k), steps,
+                            reps=3 if on_tpu else 1)
     ips = batch * n_steps / dt
     fwd = mln_forward_flops(conf)
     step_flops = train_step_flops(fwd, batch)
@@ -266,7 +268,8 @@ def bench_vgg16(batch=32, steps=6, image_size=224, classes=1000):
     rng = np.random.default_rng(0)
     x = rng.random((batch, image_size, image_size, 3), np.float32)
     ds = _device_dataset(x, _onehot(rng, batch, classes))
-    dt, n_steps = _time_fit(net, lambda k: ExistingDataSetIterator([ds] * k), steps)
+    dt, n_steps = _time_fit(net, lambda k: ExistingDataSetIterator([ds] * k), steps,
+                            reps=3 if on_tpu else 1)
     ips = batch * n_steps / dt
     fwd = mln_forward_flops(conf)
     step_flops = train_step_flops(fwd, batch)

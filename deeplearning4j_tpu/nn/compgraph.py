@@ -453,17 +453,14 @@ class ComputationGraph(NetworkBase):
         seed_key_base = self.net_conf.seed ^ 0x5EED
 
         def step(params, states, upd_state, xs, ys, fms, lms, lrs, t0):
-            # t0: exact uint32 iteration counter (float32 would collapse
-            # consecutive steps' dropout rng past 2^24 iterations)
             key = jax.random.PRNGKey(seed_key_base)
 
             def scan_body(carry, inp):
                 p, st, us = carry
                 xs_i, ys_i, fms_i, lms_i, lr, i = inp
-                ti = t0 + i
-                rng = jax.random.fold_in(key, ti)
+                rng, t = self._step_rng_and_t(key, t0, i)
                 p, st, us, sc = body(p, st, us, xs_i, ys_i, fms_i, lms_i,
-                                     lr, ti.astype(jnp.float32), rng)
+                                     lr, t, rng)
                 return (p, st, us), sc
 
             (params, states, upd_state), scores = jax.lax.scan(

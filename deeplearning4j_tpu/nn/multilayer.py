@@ -439,18 +439,13 @@ class MultiLayerNetwork(NetworkBase):
         seg_data = self._make_seg_data(seg, bwd)
 
         def step(params, states, upd_state, data, lrs, t0, _rng_unused):
-            # t0 is the iteration counter as EXACT uint32 — deriving it
-            # from a float32 t would collapse consecutive steps (and their
-            # dropout rng) past 2^24 iterations
             x, y, fm, lm = data
             key = jax.random.PRNGKey(seed_key_base)
 
             def run_seg(params, states, upd_state, i):
-                ti = t0 + jnp.asarray(i, t0.dtype)
-                rng = jax.random.fold_in(key, ti)
+                rng, t = self._step_rng_and_t(key, t0, i)
                 return body(params, states, upd_state,
-                            seg_data(x, y, fm, lm, i), lrs[i],
-                            ti.astype(jnp.float32), rng)
+                            seg_data(x, y, fm, lm, i), lrs[i], t, rng)
 
             # segment 0 inline: its merged states establish the carry
             # pytree (zero-state {} -> populated h/c) for the scan
@@ -832,16 +827,13 @@ class MultiLayerNetwork(NetworkBase):
         seed_key_base = self.net_conf.seed ^ 0x5EED
 
         def step(params, states, upd_state, data_stack, lrs, t0):
-            # t0: exact uint32 iteration counter (see _build_tbptt_fused_step)
             key = jax.random.PRNGKey(seed_key_base)
 
             def scan_body(carry, inp):
                 p, st, us = carry
                 data_i, lr, i = inp
-                ti = t0 + i
-                rng = jax.random.fold_in(key, ti)
-                p, st, us, sc = body(p, st, us, data_i, lr,
-                                     ti.astype(jnp.float32), rng)
+                rng, t = self._step_rng_and_t(key, t0, i)
+                p, st, us, sc = body(p, st, us, data_i, lr, t, rng)
                 return (p, st, us), sc
 
             (params, states, upd_state), scores = jax.lax.scan(
@@ -904,11 +896,10 @@ class MultiLayerNetwork(NetworkBase):
                 None if a is None else a[b] for a in data_stack)
 
             def run_seg(p, st, us, data_b, i_seg, j):
-                ti = t0 + jnp.asarray(j, t0.dtype)
-                rng = jax.random.fold_in(key, ti)
+                rng, t = self._step_rng_and_t(key, t0, j)
                 x, y, fm, lm = data_b
                 return body(p, st, us, seg_data(x, y, fm, lm, i_seg),
-                            lrs[j], ti.astype(jnp.float32), rng)
+                            lrs[j], t, rng)
 
             # batch 0 / segment 0 inline: bootstraps the carry structure
             data0 = pick(0)

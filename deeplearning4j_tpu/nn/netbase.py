@@ -111,6 +111,19 @@ class NetworkBase:
     def _fit_datasets_fused(self, ds_list):
         raise NotImplementedError
 
+    @staticmethod
+    def _step_rng_and_t(key, t0, i):
+        """Per-step (rng, t) inside a fused scan: t0 is the iteration
+        counter as EXACT uint32 (float32 would collapse consecutive
+        steps' dropout rng past 2^24 iterations), i the scan index. The
+        ONE derivation every fused program shares with `_run_step`'s
+        per-step fold_in(key, iteration)."""
+        import jax
+        import jax.numpy as jnp
+
+        ti = t0 + jnp.asarray(i, t0.dtype)
+        return jax.random.fold_in(key, ti), ti.astype(jnp.float32)
+
     def _ds_signature(self, ds):
         """Shape/mask signature — only identically-shaped consecutive
         batches are stacked into one fused dispatch."""

@@ -257,6 +257,21 @@ class SqliteStatsStorage(StatsStorage):
                 (session_id, since_iteration)).fetchall()
         return [decode_record(r[0]) for r in rows]
 
+    def latest_session_id(self):
+        """Indexed override of the base scan: the dashboard polls this
+        per request — decoding every record of every session to find the
+        newest timestamp would defeat this store's purpose."""
+        with self._lock:
+            row = self._db.execute(
+                "SELECT session FROM updates ORDER BY ts DESC LIMIT 1"
+            ).fetchone()
+            if row is None:
+                row = self._db.execute(
+                    "SELECT session, json_extract(info, '$.start_time')"
+                    " AS st FROM static_info ORDER BY st DESC LIMIT 1"
+                ).fetchone()
+        return row[0] if row else None
+
     def close(self):
         with self._lock:
             self._db.close()
