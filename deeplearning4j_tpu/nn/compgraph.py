@@ -282,11 +282,63 @@ class ComputationGraph(NetworkBase):
             for lc, p in zip(self._layer_confs, self.params_list)
         ]
 
-    def _make_step_body(self, collect: bool = False):
-        """Unjitted optimizer-step body (same tail as MultiLayerNetwork's:
-        gradient masking/normalization, per-leaf lr, updater, param
-        update). Shared by the single-step and multi-batch fused
-        programs."""
+    @staticmethod
+    def _jas(lst):
+        """Optional list-of-optional-arrays -> device arrays (mask lists
+        may be None wholesale or per-entry)."""
+        if lst is None:
+            return None
+        return [None if a is None else jnp.asarray(a) for a in lst]
+
+    def _seeded_states(self):
+        """state_list copy with {} seeded for recurrent layers (the
+        TBPTT zero-state trigger, shared by the loop and fused paths)."""
+        states = list(self.state_list)
+        for i, lc in enumerate(self._layer_confs):
+            if _is_recurrent(lc) and states[i] is None:
+                states[i] = {}
+        return states
+
+    def _std_loss_builder(self):
+        def loss_builder(p, states, data, rng):
+            xs, ys, fms, lms = data
+            return self._loss(p, states, xs, ys, fms, lms, rng)
+
+        return loss_builder
+
+    def _trunc_loss_builder(self):
+        """TBPTT loss with tbptt_bwd_length < tbptt_fwd_length: slice A
+        advances state under stop_gradient (score counts, no gradient),
+        slice B backprops — same design as MultiLayerNetwork's
+        _trunc_loss_builder, generalized to multi-input/multi-output."""
+
+        def loss_builder(p, states, data, rng):
+            xsA, ysA, fmsA, lmsA, xsB, ysB, fmsB, lmsB = data
+            lossA, statesA = self._loss(p, states, xsA, ysA, fmsA, lmsA,
+                                        rng)
+            carried = self._merge_states(states, statesA)
+            carried = jax.tree_util.tree_map(jax.lax.stop_gradient, carried)
+            lossB, statesB = self._loss(
+                p, carried, xsB, ysB, fmsB, lmsB,
+                None if rng is None else jax.random.fold_in(rng, 1),
+            )
+            nA = max(x.shape[1] for x in xsA if x.ndim == 3)
+            nB = max(x.shape[1] for x in xsB if x.ndim == 3)
+            score = (
+                jax.lax.stop_gradient(lossA) * nA + lossB * nB
+            ) / (nA + nB)
+            return score, self._merge_states(carried, statesB)
+
+        return loss_builder
+
+    def _make_step_body(self, loss_builder=None, collect: bool = False):
+        """Unjitted optimizer-step body around a loss builder
+        (p, states, data, rng) -> (score, new_states) — same tail as
+        MultiLayerNetwork's: gradient masking/normalization, per-leaf lr,
+        updater, param update. Shared by the single-step, truncated,
+        fused-TBPTT and multi-batch programs."""
+        if loss_builder is None:
+            loss_builder = self._std_loss_builder()
         gnorm = self.net_conf.gradient_normalization
         gthresh = self.net_conf.gradient_normalization_threshold
         mults = self._lr_mult_tree()
@@ -294,9 +346,9 @@ class ComputationGraph(NetworkBase):
         updater = self.updater_def
         minimize = self.net_conf.minimize
 
-        def step(params, states, upd_state, xs, ys, f_masks, l_masks, lr, t, rng):
+        def step(params, states, upd_state, data, lr, t, rng):
             def loss_fn(p):
-                return self._loss(p, states, xs, ys, f_masks, l_masks, rng)
+                return loss_builder(p, states, data, rng)
 
             (score, new_states), grads = jax.value_and_grad(
                 loss_fn, has_aux=True
@@ -328,8 +380,14 @@ class ComputationGraph(NetworkBase):
         return step
 
     def _build_train_step(self):
-        step = self._make_step_body(
+        body = self._make_step_body(
             collect=bool(getattr(self, "_collect_stats", False)))
+
+        def step(params, states, upd_state, xs, ys, f_masks, l_masks,
+                 lr, t, rng):
+            return body(params, states, upd_state,
+                        (xs, ys, f_masks, l_masks), lr, t, rng)
+
         backend = jax.default_backend()
         donate = (0, 2) if backend != "cpu" else ()
         return jax.jit(step, donate_argnums=donate)
@@ -342,13 +400,10 @@ class ComputationGraph(NetworkBase):
             jax.random.PRNGKey(self.net_conf.seed ^ 0x5EED), self.iteration
         )
         states = stateful_states if stateful_states is not None else self.state_list
-        jas = lambda t: None if t is None else [
-            None if a is None else jnp.asarray(a) for a in t
-        ]
         out = self._train_step_fn(
             self.params_list, states, self.upd_state,
             [jnp.asarray(x) for x in xs], [jnp.asarray(y) for y in ys],
-            jas(f_masks), jas(l_masks),
+            self._jas(f_masks), self._jas(l_masks),
             jnp.asarray(lr, jnp.float32), jnp.asarray(float(self.iteration)),
             rng,
         )
@@ -402,9 +457,10 @@ class ComputationGraph(NetworkBase):
 
     def _fit_datasets_fused(self, ds_list):
         """K same-shape minibatches in ONE jitted dispatch (see
-        NetworkBase.set_fused_steps). TBPTT graphs keep the per-batch
-        path (the MLN carries the recurrent benchmarks; fusing the CG
-        TBPTT loop would duplicate that machinery for little gain)."""
+        NetworkBase.set_fused_steps). TBPTT graphs run per-batch — each
+        batch still fuses ALL its segments into one dispatch via
+        _fit_tbptt_fused; only the cross-batch stacking is MLN-only (the
+        MLN carries the recurrent benchmarks)."""
         mds_list = [_as_multidataset(d) for d in ds_list]
         if (
             self.conf.backprop_type == "tbptt"
@@ -459,8 +515,8 @@ class ComputationGraph(NetworkBase):
                 p, st, us = carry
                 xs_i, ys_i, fms_i, lms_i, lr, i = inp
                 rng, t = self._step_rng_and_t(key, t0, i)
-                p, st, us, sc = body(p, st, us, xs_i, ys_i, fms_i, lms_i,
-                                     lr, t, rng)
+                p, st, us, sc = body(p, st, us,
+                                     (xs_i, ys_i, fms_i, lms_i), lr, t, rng)
                 return (p, st, us), sc
 
             (params, states, upd_state), scores = jax.lax.scan(
@@ -477,14 +533,36 @@ class ComputationGraph(NetworkBase):
         feature/label/mask is segmented into tbptt_fwd_length chunks; RNN
         state carries across segment steps (reference:
         ComputationGraph.doTruncatedBPTT — same segment loop as the MLN
-        path, generalized to multi-input/multi-output)."""
+        path, generalized to multi-input/multi-output).
+
+        When eligible (no ragged tail, every temporal array shares T, no
+        listeners, no stats collection) all segments run in ONE jitted
+        dispatch — the same fused treatment as
+        MultiLayerNetwork._fit_tbptt_fused; listeners keep the loop path
+        so per-iteration callbacks observe their iteration's params."""
         T = max(f.shape[1] for f in mds.features if f.ndim == 3)
         seg = int(self.conf.tbptt_fwd_length)
         bwd = int(self.conf.tbptt_bwd_length)
-        states = list(self.state_list)
-        for i, lc in enumerate(self._layer_confs):
-            if _is_recurrent(lc) and states[i] is None:
-                states[i] = {}
+        n_seg = -(-T // seg)
+        uniform_T = all(
+            a.shape[1] == T
+            for group in (mds.features, mds.labels) for a in group
+            if a.ndim == 3
+        ) and all(
+            m.shape[1] == T
+            for group in (mds.features_masks, mds.labels_masks)
+            if group is not None for m in group
+            if m is not None and m.ndim == 2
+        )
+        if (
+            T == n_seg * seg
+            and uniform_T
+            and not self.listeners
+            and not getattr(self, "_collect_stats", False)
+        ):
+            self._fit_tbptt_fused(mds, n_seg, seg, bwd)
+            return
+        states = self._seeded_states()
 
         def cut_mask(m, sl):
             if m is None:
@@ -522,82 +600,141 @@ class ComputationGraph(NetworkBase):
             for i, (lc, st) in enumerate(zip(self._layer_confs, states))
         ]
 
+    @staticmethod
+    def _make_seg_data_multi(seg: int, bwd: int):
+        """Multi-input TBPTT time segmentation under jit (the list analog
+        of MultiLayerNetwork._make_seg_data): temporal arrays (3-d
+        features/labels, 2-d masks) get dynamic_slice'd, static arrays
+        (2-d labels, 1-d per-example masks) pass through whole."""
+
+        def seg_slice(a, start, length):
+            return jax.lax.dynamic_slice_in_dim(a, start, length, axis=1)
+
+        def cut_arrays(lst, s0, ln):
+            return [seg_slice(a, s0, ln) if a.ndim == 3 else a for a in lst]
+
+        def cut_masks(lst, s0, ln):
+            if lst is None:
+                return None
+            return [
+                None if m is None
+                else (m if m.ndim == 1 else seg_slice(m, s0, ln))
+                for m in lst
+            ]
+
+        def seg_data(xs, ys, fms, lms, i):
+            start = i * seg
+            if bwd < seg:
+                nA = seg - bwd
+                return (
+                    cut_arrays(xs, start, nA), cut_arrays(ys, start, nA),
+                    cut_masks(fms, start, nA), cut_masks(lms, start, nA),
+                    cut_arrays(xs, start + nA, bwd),
+                    cut_arrays(ys, start + nA, bwd),
+                    cut_masks(fms, start + nA, bwd),
+                    cut_masks(lms, start + nA, bwd),
+                )
+            return (cut_arrays(xs, start, seg), cut_arrays(ys, start, seg),
+                    cut_masks(fms, start, seg), cut_masks(lms, start, seg))
+
+        return seg_data
+
+    def _build_tbptt_fused_step(self, n_seg: int, seg: int, bwd: int):
+        """ALL of a batch's TBPTT segments in ONE jitted dispatch — the
+        ComputationGraph twin of MultiLayerNetwork._build_tbptt_fused_step
+        (same per-segment lr/t/rng, same optimizer tail; equivalence:
+        tests/test_fused_fit.py). Callers guarantee T == n_seg * seg and
+        that stats collection is off."""
+        assert not getattr(self, "_collect_stats", False)
+        body = self._make_step_body(
+            self._trunc_loss_builder() if bwd < seg
+            else self._std_loss_builder()
+        )
+        seed_key_base = self.net_conf.seed ^ 0x5EED
+        seg_data = self._make_seg_data_multi(seg, bwd)
+
+        def step(params, states, upd_state, data, lrs, t0, _rng_unused):
+            xs, ys, fms, lms = data
+            key = jax.random.PRNGKey(seed_key_base)
+
+            def run_seg(params, states, upd_state, i):
+                rng, t = self._step_rng_and_t(key, t0, i)
+                return body(params, states, upd_state,
+                            seg_data(xs, ys, fms, lms, i), lrs[i], t, rng)
+
+            # segment 0 inline: its merged states establish the carry
+            # pytree (zero-state {} -> populated h/c) for the scan
+            params, states, upd_state, s0 = run_seg(
+                params, states, upd_state, 0)
+            if n_seg == 1:
+                return params, states, upd_state, s0
+
+            def scan_body(carry, i):
+                p, st, us = carry
+                p, st, us, score = run_seg(p, st, us, i)
+                return (p, st, us), score
+
+            (params, states, upd_state), scores = jax.lax.scan(
+                scan_body, (params, states, upd_state),
+                jnp.arange(1, n_seg))
+            return params, states, upd_state, scores[-1]
+
+        backend = jax.default_backend()
+        donate = (0, 2) if backend != "cpu" else ()
+        return jax.jit(step, donate_argnums=donate)
+
+    def _fit_tbptt_fused(self, mds: MultiDataSet, n_seg: int, seg: int,
+                         bwd: int):
+        sig = (n_seg, seg, bwd)
+        cached = getattr(self, "_fused_tbptt_fn", None)
+        if cached is None or cached[0] != sig:
+            self._fused_tbptt_fn = (
+                sig, self._build_tbptt_fused_step(n_seg, seg, bwd))
+        step_fn = self._fused_tbptt_fn[1]
+        states = self._seeded_states()
+        lrs = jnp.asarray(
+            [schedule_lr(self.net_conf, self.iteration + i)
+             for i in range(n_seg)], jnp.float32)
+        data = ([jnp.asarray(x) for x in mds.features],
+                [jnp.asarray(y) for y in mds.labels],
+                self._jas(mds.features_masks), self._jas(mds.labels_masks))
+        params, states, upd, last = step_fn(
+            self.params_list, states, self.upd_state, data, lrs,
+            jnp.asarray(self.iteration, jnp.uint32), None)
+        self.params_list = params
+        self.upd_state = upd
+        self._score = last
+        self._last_stats = None
+        self.iteration += n_seg
+        # persist only non-RNN state (running stats); RNN carry is per-batch
+        self.state_list = [
+            st if not _is_recurrent(lc) else self.state_list[i]
+            for i, (lc, st) in enumerate(zip(self._layer_confs, states))
+        ]
+
     def _fit_step_truncated(self, dataA, dataB, stateful_states):
-        """TBPTT segment step with a backward-truncation boundary: slice A
-        advances state under stop_gradient (score counts, no gradient),
-        slice B backprops — gradient depth is exactly tbptt_bwd_length
-        (same design as MultiLayerNetwork._build_truncated_bwd_step)."""
+        """TBPTT segment step with a backward-truncation boundary (the
+        truncated loss builder above) — one jitted call per segment on
+        the loop path."""
         if getattr(self, "_trunc_step_fn", None) is None:
-            gnorm = self.net_conf.gradient_normalization
-            gthresh = self.net_conf.gradient_normalization_threshold
-            mults = self._lr_mult_tree()
-            tmask = self._trainable_mask()
-            updater = self.updater_def
-            minimize = self.net_conf.minimize
-
-            def step(params, states, upd_state, dA, dB, lr, t, rng):
-                def loss_fn(p):
-                    xA, yA, fmA, lmA = dA
-                    xB, yB, fmB, lmB = dB
-                    lossA, statesA = self._loss(p, states, xA, yA, fmA, lmA, rng)
-                    carried = self._merge_states(states, statesA)
-                    carried = jax.tree_util.tree_map(
-                        jax.lax.stop_gradient, carried
-                    )
-                    lossB, statesB = self._loss(
-                        p, carried, xB, yB, fmB, lmB,
-                        None if rng is None else jax.random.fold_in(rng, 1),
-                    )
-                    nA = max(x.shape[1] for x in xA if x.ndim == 3)
-                    nB = max(x.shape[1] for x in xB if x.ndim == 3)
-                    score = (
-                        jax.lax.stop_gradient(lossA) * nA + lossB * nB
-                    ) / (nA + nB)
-                    return score, self._merge_states(carried, statesB)
-
-                (score, new_states), grads = jax.value_and_grad(
-                    loss_fn, has_aux=True
-                )(params)
-                if not minimize:
-                    grads = jax.tree_util.tree_map(lambda g: -g, grads)
-                grads = [
-                    {k: g[k] * m[k] for k in g} for g, m in zip(grads, tmask)
-                ]
-                grads = normalize_gradients(grads, gnorm, gthresh)
-                lr_tree = [
-                    {k: lr * m[k] for k in g} for g, m in zip(grads, mults)
-                ]
-                updates, new_upd = updater.apply_tree(grads, upd_state, lr_tree, t)
-                new_params = jax.tree_util.tree_map(jnp.add, params, updates)
-                if collect:
-                    mm = lambda tree: [
-                        {k: jnp.mean(jnp.abs(v)) for k, v in p.items()}
-                        for p in tree
-                    ]
-                    stats = {"grad_mm": mm(grads), "update_mm": mm(updates),
-                             "param_mm": mm(new_params)}
-                    return new_params, new_states, new_upd, score, stats
-                return new_params, new_states, new_upd, score
-
-            collect = bool(getattr(self, "_collect_stats", False))
+            body = self._make_step_body(
+                self._trunc_loss_builder(),
+                collect=bool(getattr(self, "_collect_stats", False)))
             backend = jax.default_backend()
             donate = (0, 2) if backend != "cpu" else ()
-            self._trunc_step_fn = jax.jit(step, donate_argnums=donate)
+            self._trunc_step_fn = jax.jit(body, donate_argnums=donate)
 
         lr = schedule_lr(self.net_conf, self.iteration)
         rng = jax.random.fold_in(
             jax.random.PRNGKey(self.net_conf.seed ^ 0x5EED), self.iteration
         )
-        jas = lambda t: None if t is None else [
-            None if a is None else jnp.asarray(a) for a in t
-        ]
         pack = lambda d: (
             [jnp.asarray(x) for x in d[0]], [jnp.asarray(y) for y in d[1]],
-            jas(d[2]), jas(d[3]),
+            self._jas(d[2]), self._jas(d[3]),
         )
         out = self._trunc_step_fn(
             self.params_list, stateful_states, self.upd_state,
-            pack(dataA), pack(dataB),
+            pack(dataA) + pack(dataB),
             jnp.asarray(lr, jnp.float32), jnp.asarray(float(self.iteration)),
             rng,
         )

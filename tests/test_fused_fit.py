@@ -12,6 +12,7 @@ at the XLA level."""
 import numpy as np
 import jax
 import jax.numpy as jnp
+import pytest
 
 from deeplearning4j_tpu.data import DataSet
 from deeplearning4j_tpu.data.iterators import ListDataSetIterator
@@ -170,6 +171,71 @@ def test_fused_graph_matches_loop():
     assert fused.iteration == loop.iteration == 12
     assert _max_tree_diff(loop.params_list, fused.params_list) < 1e-6
     assert _max_tree_diff(loop.upd_state, fused.upd_state) < 1e-6
+
+
+def _rnn_graph_conf(fwd=4, bwd=4):
+    return (
+        NeuralNetConfiguration.builder().seed(5)
+        .updater("adam").learning_rate(0.02)
+        .graph_builder().add_inputs("seq")
+        .add_layer("lstm", LSTM(n_out=8, activation="tanh"), "seq")
+        .add_layer("out", RnnOutputLayer(n_out=2, activation="softmax",
+                                         loss="mcxent"), "lstm")
+        .set_outputs("out")
+        .set_input_types(InputType.recurrent(3))
+        .backprop_type("tbptt")
+        .t_bptt_lengths(fwd, bwd)
+        .build()
+    )
+
+
+def _seq_xy(n=32, t=12, seed=2):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, t, 3)).astype(np.float32)
+    cs = np.cumsum(x[..., 0], axis=1)
+    y = np.zeros((n, t, 2), np.float32)
+    y[..., 0] = (cs <= 0).astype(np.float32)
+    y[..., 1] = (cs > 0).astype(np.float32)
+    return x, y
+
+
+class _NoOp:
+    def iteration_done(self, model, iteration, info):
+        pass
+
+    def on_epoch_start(self, model, epoch):
+        pass
+
+    def on_epoch_end(self, model, epoch):
+        pass
+
+
+@pytest.mark.parametrize("fwd,bwd", [(4, 4), (6, 3)])
+def test_graph_tbptt_fused_matches_loop(fwd, bwd):
+    """CG fused-TBPTT (all segments one dispatch) == per-segment loop,
+    incl. the bwd<fwd truncated builder — the ComputationGraph twin of
+    tests/test_tbptt_fused.py (a listener forces the loop path)."""
+    x, y = _seq_xy(t=12)
+    loop = ComputationGraph(_rnn_graph_conf(fwd, bwd)).init()
+    loop.add_listener(_NoOp())
+    fused = ComputationGraph(_rnn_graph_conf(fwd, bwd)).init()
+    for net in (loop, fused):
+        net.fit(x, y, epochs=2, batch_size=16, async_prefetch=False)
+    assert fused.iteration == loop.iteration
+    assert _max_tree_diff(loop.params_list, fused.params_list) < 1e-6
+    assert _max_tree_diff(loop.upd_state, fused.upd_state) < 1e-6
+    assert abs(float(loop._score) - float(fused._score)) < 1e-6
+
+
+def test_graph_tbptt_ragged_tail_falls_back():
+    x, y = _seq_xy(t=10)  # 10 % 4 != 0 -> loop path on both
+    loop = ComputationGraph(_rnn_graph_conf(4, 4)).init()
+    loop.add_listener(_NoOp())
+    fused = ComputationGraph(_rnn_graph_conf(4, 4)).init()
+    for net in (loop, fused):
+        net.fit(x, y, epochs=1, batch_size=16, async_prefetch=False)
+    assert fused.iteration == loop.iteration == 2 * 3
+    assert _max_tree_diff(loop.params_list, fused.params_list) < 1e-6
 
 
 def test_fused_listeners_disable_fusion():
