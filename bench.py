@@ -2,8 +2,9 @@
 
 Headline: ResNet-50 training images/sec/chip (BASELINE.md metric of
 record) with an analytic-MFU estimate; the `workloads` field carries the
-full table (LeNet-MNIST images/sec, GravesLSTM char-rnn tokens/sec), each
-with its own MFU.
+full table (LeNet-MNIST images/sec, GravesLSTM char-rnn tokens/sec, each
+with its own MFU, plus `parallel_inference` serving requests/sec/chip
+with p50/p99 latency).
 
 Protocol (BASELINE.md): synthetic data (BenchmarkDataSetIterator
 equivalent) to exclude ETL; public fit() API drives every workload;
@@ -343,12 +344,123 @@ def bench_word2vec(vocab=10_000, n_sents=2_000, sent_len=40, batch=8192,
     }
 
 
+def bench_parallel_inference(max_batch=64, n_requests=512, clients=16,
+                             n_in=128, hidden=256, classes=16):
+    """Serving throughput/latency through the bucketed BATCHED
+    ParallelInference path (the InferenceServer's engine): `clients`
+    threads submit a mixed-size request stream — sizes 1..max_batch drawn
+    zipf-ish (weight 1/size), the small-request-heavy profile of real
+    serving traffic — and the workload reports requests/sec/chip plus
+    p50/p99 request latency. warmup() precompiles every bucket first, so
+    `forward_compiles_after_warmup` staying at 0 IS the bucketing win
+    (before this path, every distinct fused group size was a fresh trace).
+    Each latency sample ends at the caller's numpy readback (the dispatch
+    thread materializes results host-side — the honest sync on this box,
+    where block_until_ready does not block through the tunnel)."""
+    import threading
+
+    from deeplearning4j_tpu.nn.conf import (
+        DenseLayer,
+        NeuralNetConfiguration,
+        OutputLayer,
+        Updater,
+    )
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.parallel import (
+        ParallelInference,
+        data_parallel_mesh,
+    )
+    from deeplearning4j_tpu.utils.latency import LatencyTracker
+
+    on_tpu = jax.default_backend() not in ("cpu",)
+    if not on_tpu:
+        n_requests, clients, hidden = 96, 8, 64
+    conf = (
+        NeuralNetConfiguration.builder().seed(7).updater(Updater.SGD)
+        .learning_rate(0.05).weight_init("xavier")
+        .precision("bf16" if on_tpu else "f32").list()
+        .layer(DenseLayer(n_in=n_in, n_out=hidden, activation="relu"))
+        .layer(DenseLayer(n_in=hidden, n_out=hidden, activation="relu"))
+        .layer(OutputLayer(n_in=hidden, n_out=classes,
+                           activation="softmax", loss="mcxent"))
+        .build()
+    )
+    net = MultiLayerNetwork(conf).init()
+    pi = ParallelInference(net, data_parallel_mesh(),
+                           max_batch_size=max_batch, batch_timeout_ms=2.0)
+    pi.warmup((n_in,))
+    compiles_warm = int(net.output_compile_count)
+
+    rng = np.random.default_rng(0)
+    sizes = np.arange(1, max_batch + 1)
+    p = 1.0 / sizes
+    p /= p.sum()
+    req_sizes = rng.choice(sizes, size=n_requests, p=p)
+    reqs = [rng.standard_normal((int(s), n_in)).astype(np.float32)
+            for s in req_sizes]
+
+    lat = LatencyTracker(window=n_requests)
+    next_idx = [0]
+    idx_lock = threading.Lock()
+    client_errors = []
+
+    def client():
+        try:
+            while True:
+                with idx_lock:
+                    i = next_idx[0]
+                    if i >= len(reqs):
+                        return
+                    next_idx[0] = i + 1
+                t0 = time.perf_counter()
+                out = pi.output(reqs[i])
+                assert out.shape[0] == reqs[i].shape[0]
+                lat.record(time.perf_counter() - t0)
+        except BaseException as e:
+            client_errors.append(f"{type(e).__name__}: {e}")
+
+    threads = [threading.Thread(target=client) for _ in range(clients)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    dt = time.perf_counter() - t0
+    if client_errors or lat.count != n_requests:
+        # a silently-dead client would otherwise leave requests/sec counting
+        # requests that were never served
+        raise RuntimeError(
+            f"served {lat.count}/{n_requests}; errors: {client_errors[:3]}")
+    m = pi.metrics()
+    pi.shutdown()
+    snap = lat.snapshot()
+    return {
+        "value": round(n_requests / dt, 1),
+        "unit": "requests/sec/chip",
+        "examples_per_sec": round(int(req_sizes.sum()) / dt, 1),
+        "p50_ms": snap["p50_ms"],
+        "p99_ms": snap["p99_ms"],
+        "clients": clients,
+        "n_requests": n_requests,
+        "distinct_request_sizes": int(len(set(req_sizes.tolist()))),
+        "max_batch_size": max_batch,
+        "buckets": m["buckets"],
+        "batches": m["batches"],
+        "bucket_hits": {str(k): v for k, v in m["bucket_hits"].items()},
+        "forward_compiles_warmup": compiles_warm,
+        "forward_compiles_after_warmup":
+            m["forward_compiles"] - compiles_warm,
+        "seconds": round(dt, 3),
+    }
+
+
 WORKLOADS = {
     "resnet50": bench_resnet50,
     "lenet": bench_lenet,
     "char_lstm": bench_char_lstm,
     "word2vec": bench_word2vec,
     "vgg16_keras_import": bench_vgg16,
+    "parallel_inference": bench_parallel_inference,
 }
 
 # Per-workload subprocess timeouts (seconds). First compile through the
@@ -361,6 +473,7 @@ TIMEOUTS = {
     "char_lstm": 600,
     "word2vec": 600,
     "vgg16_keras_import": 600,
+    "parallel_inference": 420,
 }
 PROBE_TIMEOUT = 120  # tiny matmul + readback; generous for backend init
 OVERALL_DEADLINE = float(os.environ.get("BENCH_DEADLINE_SEC", 1500))

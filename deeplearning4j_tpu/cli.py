@@ -5,6 +5,7 @@ serialized model from flags; NearestNeighborsServer; PlayUIServer runnable).
         --epochs 3 --batch-size 32 --output trained.zip --ui-port 9090
     python -m deeplearning4j_tpu.cli evaluate --model-path m.zip --data iris
     python -m deeplearning4j_tpu.cli knn-server --ndarray-path pts.npy
+    python -m deeplearning4j_tpu.cli inference-server --model-path m.zip
     python -m deeplearning4j_tpu.cli ui-server --stats-file stats.bin
 
 Data sources: mnist | cifar10 | iris | lfw | csv:<path>:<labelIndex>:<numClasses>
@@ -166,6 +167,23 @@ def cmd_knn_server(args) -> int:
     return 0
 
 
+def cmd_inference_server(args) -> int:
+    from deeplearning4j_tpu.serving.inference_server import main as inf_main
+
+    argv = [
+        "--modelPath", args.model_path,
+        "--port", str(args.port),
+        "--maxBatchSize", str(args.max_batch_size),
+        "--batchTimeoutMs", str(args.batch_timeout_ms),
+    ]
+    if args.buckets:
+        argv += ["--buckets", args.buckets]
+    if args.warmup_shape:
+        argv += ["--warmupShape", args.warmup_shape]
+    inf_main(argv)
+    return 0
+
+
 def cmd_ui_server(args) -> int:
     from deeplearning4j_tpu.ui import FileStatsStorage, UIServer
 
@@ -249,6 +267,19 @@ def main(argv=None) -> int:
     k.add_argument("--similarity-function", default="euclidean")
     k.add_argument("--invert", action="store_true")
     k.set_defaults(fn=cmd_knn_server)
+
+    i = sub.add_parser(
+        "inference-server",
+        help="REST model serving (bucketed+pipelined ParallelInference)")
+    i.add_argument("--model-path", required=True)
+    i.add_argument("--port", type=int, default=9100)
+    i.add_argument("--max-batch-size", type=int, default=64)
+    i.add_argument("--batch-timeout-ms", type=float, default=2.0)
+    i.add_argument("--buckets", default=None,
+                   help="comma-separated batch-size buckets")
+    i.add_argument("--warmup-shape", default=None,
+                   help="feature shape to precompile, e.g. 784 or 28,28,1")
+    i.set_defaults(fn=cmd_inference_server)
 
     u = sub.add_parser("ui-server", help="dashboard over a stats file")
     u.add_argument("--stats-file", required=True)

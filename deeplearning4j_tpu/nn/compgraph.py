@@ -755,18 +755,7 @@ class ComputationGraph(NetworkBase):
         with the graph's inputs and feeds mask-aware vertices such as
         LastTimeStepVertex)."""
         self._require_init()
-        if self._output_fn is None:
-            def fwd(params, states, xs, masks):
-                xs = [self.policy.cast_input(x) for x in xs]
-                acts, _ = self._forward(
-                    params, states, xs, training=False, rng=None,
-                    input_masks=masks,
-                )
-                return [
-                    self.policy.cast_output(acts[n]) for n in self.conf.outputs
-                ]
-
-            self._output_fn = jax.jit(fwd)
+        xs = [jnp.asarray(x) for x in inputs]
         masks = None
         if input_masks is not None:
             if len(input_masks) != len(self.conf.inputs):
@@ -778,10 +767,30 @@ class ComputationGraph(NetworkBase):
             masks = [
                 None if m is None else jnp.asarray(m) for m in input_masks
             ]
-        outs = self._output_fn(
-            self.params_list, self.state_list,
-            [jnp.asarray(x) for x in inputs], masks,
+        # shape-keyed jit cache + compile counter (same contract as
+        # MultiLayerNetwork.output — see output_compile_count)
+        key = (
+            tuple((x.shape, str(x.dtype)) for x in xs),
+            None if masks is None else tuple(
+                None if m is None else (m.shape, str(m.dtype)) for m in masks
+            ),
         )
+        def make_fn():
+            def fwd(params, states, xs, masks):
+                xs = [self.policy.cast_input(x) for x in xs]
+                acts, _ = self._forward(
+                    params, states, xs, training=False, rng=None,
+                    input_masks=masks,
+                )
+                return [
+                    self.policy.cast_output(acts[n])
+                    for n in self.conf.outputs
+                ]
+
+            return jax.jit(fwd)
+
+        fn = self._cached_output_fn(key, make_fn)
+        outs = fn(self.params_list, self.state_list, xs, masks)
         return outs[0] if len(outs) == 1 else outs
 
     def feed_forward(self, *inputs):

@@ -976,24 +976,30 @@ class MultiLayerNetwork(NetworkBase):
     def output(self, x, training: bool = False):
         """Full forward pass (reference: MultiLayerNetwork.output).
         training=True gives train-mode activations (dropout active, batch
-        statistics) with a deterministic per-call rng."""
+        statistics) with a deterministic per-call rng.
+
+        The jit cache is keyed on (training, input shape, dtype), and every
+        insertion bumps `output_compile_count` — serving layers
+        (ParallelInference /metrics) read it so that shape-driven compile
+        storms show up as a counter instead of mystery tail latency."""
         self._require_init()
-        if self._output_fn is None:
-            self._output_fn = {}
-        if training not in self._output_fn:
+        xx = jnp.asarray(x)
+
+        def make_fn():
             def fwd(params, states, xx, rng):
                 xx = self.policy.cast_input(xx)
                 out, _ = self._forward(params, states, xx,
                                        training=training, rng=rng)
                 return self.policy.cast_output(out)
 
-            self._output_fn[training] = jax.jit(fwd)
+            return jax.jit(fwd)
+
+        fn = self._cached_output_fn(
+            (training, xx.shape, str(xx.dtype)), make_fn)
         rng = (
             jax.random.PRNGKey(self.net_conf.seed ^ 0xD0) if training else None
         )
-        return self._output_fn[training](
-            self.params_list, self.state_list, jnp.asarray(x), rng
-        )
+        return fn(self.params_list, self.state_list, xx, rng)
 
     def feed_forward(self, x):
         """Per-layer activations list (reference: feedForward family

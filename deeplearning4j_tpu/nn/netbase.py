@@ -11,6 +11,7 @@ MultiLayerNetwork.java:102-104 flattenedParams).
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import List, Optional
 
@@ -49,6 +50,13 @@ class NetworkBase:
         # fuse K consecutive same-shape minibatches into ONE jitted
         # dispatch (set_fused_steps) — the dispatch-latency amortizer
         self._fused_k = 1
+        # forward (`output`) traces compiled so far — bumped by the
+        # subclasses' shape-keyed output caches; serving layers surface it
+        # so a compile storm is a metric, not a latency mystery. The lock
+        # makes concurrent cache misses on one key produce ONE entry
+        # (ParallelInference calls output() from several threads)
+        self._output_compiles = 0
+        self._output_cache_lock = threading.Lock()
 
     # -- to be provided by subclasses ----------------------------------------
 
@@ -65,6 +73,29 @@ class NetworkBase:
     def _require_init(self):
         if self.params_list is None:
             self.init()
+
+    @property
+    def output_compile_count(self) -> int:
+        """Forward traces compiled by `output()` so far — one per distinct
+        (training, input shape/dtype) key. Steady state for a serving
+        workload is a constant (one per batch bucket); growth under
+        traffic means shape churn is forcing recompiles."""
+        return self._output_compiles
+
+    def _cached_output_fn(self, key, make_fn):
+        """Shape-keyed get-or-insert into the `output()` jit cache, bumping
+        `output_compile_count` on insert. Under the lock so concurrent
+        cache misses on one key (ParallelInference calls output() from
+        several threads) produce ONE entry; the actual trace happens at
+        call time outside the lock and jax serializes it internally."""
+        with self._output_cache_lock:
+            if not isinstance(self._output_fn, dict):
+                self._output_fn = {}
+            fn = self._output_fn.get(key)
+            if fn is None:
+                fn = self._output_fn[key] = make_fn()
+                self._output_compiles += 1
+            return fn
 
     # -- listeners -----------------------------------------------------------
 

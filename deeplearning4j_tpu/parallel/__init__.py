@@ -21,7 +21,12 @@ from deeplearning4j_tpu.parallel.mesh import (
     replicated,
 )
 from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
-from deeplearning4j_tpu.parallel.inference import InferenceMode, ParallelInference
+from deeplearning4j_tpu.parallel.inference import (
+    InferenceMode,
+    ParallelInference,
+    RequestValidationError,
+    power_of_two_buckets,
+)
 from deeplearning4j_tpu.parallel.tensor import shard_params_tp, tp_dense_specs
 from deeplearning4j_tpu.parallel.pipeline import (
     pipeline_apply,
@@ -44,4 +49,6 @@ __all__ = [
     "ParallelWrapper",
     "ParallelInference",
     "InferenceMode",
+    "RequestValidationError",
+    "power_of_two_buckets",
 ]

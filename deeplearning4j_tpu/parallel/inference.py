@@ -8,10 +8,28 @@ batching via BatchedInferenceObservable, inference/observers/).
 TPU-native design: one set of replicated parameters on the mesh; the
 "replica pool" is replaced by batch sharding — a dynamically-batched
 request group is sharded across the data axis and executed once. Dynamic
-batching (the BATCHED mode) is the part that carries over unchanged: a
-collector thread drains the request queue, concatenates up to
-`max_batch_size` examples, runs the jitted forward, and scatters results
-back to the waiting callers.
+batching (the BATCHED mode) carries over from the reference; two
+serving-specific mechanisms go beyond it:
+
+* **Shape buckets** — every forward runs at one of a small fixed set of
+  batch sizes (powers of two up to `max_batch_size` by default): a fused
+  group of n examples is padded up to the smallest bucket >= n by
+  cyclically wrapping rows (`mesh.pad_wrap`) and the pad rows sliced off
+  the result. Only ~log2(max_batch_size) forward traces ever compile no
+  matter how request sizes vary; without bucketing every distinct group
+  size is a fresh `jax.jit` trace of `model.output` — a compile storm.
+  `warmup()` precompiles all buckets before traffic, and `metrics()`
+  exposes per-bucket hit counts plus the model's `output_compile_count`
+  so retraces are a visible number, not mystery tail latency.
+
+* **Pipelined collect → dispatch** — the BATCHED collector is split into
+  two stages joined by a bounded handoff queue: the *collect* thread
+  drains the request queue, concatenates and bucket-pads on the host, and
+  hands the prepared group off; the *dispatch* thread runs the device
+  forward and scatters results to the waiting callers. Host batch
+  assembly of group k+1 overlaps device execution of group k (double
+  buffering — same idea as the training-side async prefetch,
+  data/iterators.AsyncDataSetIterator).
 """
 
 from __future__ import annotations
@@ -19,7 +37,7 @@ from __future__ import annotations
 import queue
 import threading
 from concurrent.futures import Future
-from typing import Optional
+from typing import List, Optional, Sequence
 
 import jax
 import numpy as np
@@ -38,6 +56,23 @@ class InferenceMode:
     BATCHED = "batched"
 
 
+class RequestValidationError(ValueError):
+    """The REQUEST was malformed (empty, or feature shape mismatching the
+    endpoint's) — distinguishes client faults from server-side ValueErrors
+    so REST layers can map 400 vs 500 correctly."""
+
+
+def power_of_two_buckets(max_batch_size: int) -> List[int]:
+    """Default bucket set: 1, 2, 4, ... up to and including
+    `max_batch_size` (appended as-is when not itself a power of two)."""
+    out, b = [], 1
+    while b < max_batch_size:
+        out.append(b)
+        b *= 2
+    out.append(int(max_batch_size))
+    return out
+
+
 class ParallelInference:
     def __init__(
         self,
@@ -46,25 +81,62 @@ class ParallelInference:
         inference_mode: str = InferenceMode.BATCHED,
         max_batch_size: int = 64,
         batch_timeout_ms: float = 2.0,
+        buckets: Optional[Sequence[int]] = None,
+        handoff_capacity: int = 2,
     ):
         self.model = model
         self.mesh = mesh if mesh is not None else data_parallel_mesh()
         self.mode = inference_mode
         self.max_batch_size = int(max_batch_size)
+        if self.max_batch_size < 1:
+            raise ValueError(
+                f"max_batch_size must be >= 1, got {max_batch_size}")
         self.batch_timeout = batch_timeout_ms / 1e3
         self.n_shards = data_shards(self.mesh)
+        if buckets is None:
+            self.buckets = power_of_two_buckets(self.max_batch_size)
+        else:
+            self.buckets = sorted({int(b) for b in buckets})
+            if not self.buckets or self.buckets[0] < 1:
+                raise ValueError(f"invalid bucket set {buckets}")
+            if self.buckets[-1] < self.max_batch_size:
+                raise ValueError(
+                    f"largest bucket {self.buckets[-1]} < max_batch_size "
+                    f"{self.max_batch_size}: a full fused group would have "
+                    f"no bucket to land in"
+                )
         model._require_init()
         rep = replicated(self.mesh)
         model.params_list = jax.tree_util.tree_map(
             lambda a: jax.device_put(a, rep), model.params_list
         )
+        # one lock guards admission (shutdown flag + expected shape) and
+        # the stats counters; device work happens outside it
+        self._lock = threading.Lock()
         self._q: "queue.Queue" = queue.Queue()
-        self._expected_shape = None  # set by the first request
+        self._handoff: "queue.Queue" = queue.Queue(maxsize=handoff_capacity)
+        self._expected_shape = None  # set by the first request (under lock)
+        # flipped by the first SUCCESSFUL forward: until then the pinned
+        # shape is provisional and a failed forward unpins it, so one
+        # malformed first request cannot poison the endpoint forever
+        self._shape_confirmed = False
         self._shutdown = False
-        self._worker: Optional[threading.Thread] = None
+        self._stats = {
+            "requests": 0,
+            "examples": 0,
+            "batches": 0,
+            "oversized": 0,
+            "bucket_hits": {b: 0 for b in self.buckets},
+        }
+        self._collect_t: Optional[threading.Thread] = None
+        self._dispatch_t: Optional[threading.Thread] = None
         if self.mode == InferenceMode.BATCHED:
-            self._worker = threading.Thread(target=self._collector, daemon=True)
-            self._worker.start()
+            self._collect_t = threading.Thread(
+                target=self._collector, daemon=True)
+            self._dispatch_t = threading.Thread(
+                target=self._dispatcher, daemon=True)
+            self._collect_t.start()
+            self._dispatch_t.start()
 
     # -- public --------------------------------------------------------------
 
@@ -72,62 +144,207 @@ class ParallelInference:
         """Thread-safe inference. In BATCHED mode the call may be fused
         with concurrent callers' batches (reference:
         BatchedInferenceObservable)."""
-        if self._shutdown:
-            raise RuntimeError("ParallelInference has been shut down")
         xx = np.asarray(x)
-        if self._expected_shape is None:
-            self._expected_shape = xx.shape[1:]
-        elif xx.shape[1:] != self._expected_shape:
-            # validate HERE, not deep inside the collector where a bad
-            # request would fail the whole fused group
+        with self._lock:
+            # shutdown check and enqueue under ONE lock: a request admitted
+            # here is visible to shutdown()'s drain, so its Future always
+            # resolves (result or explicit shutdown error) — never hangs
+            if self._shutdown:
+                raise RuntimeError("ParallelInference has been shut down")
+            if xx.shape[0] == 0:
+                # 0 is a multiple of every bucket, so an empty request
+                # would sail through _pad at 0 rows and compile a fresh
+                # 0-shape trace — reject it at admission instead
+                raise RequestValidationError("empty request (0 examples)")
+            if self._expected_shape is None:
+                # under the lock: two concurrent FIRST callers must not both
+                # see None and admit mismatched shapes into one fused group
+                self._expected_shape = xx.shape[1:]
+            elif xx.shape[1:] != self._expected_shape:
+                # validate HERE, not deep inside the collector where a bad
+                # request would fail the whole fused group
+                raise RequestValidationError(
+                    f"request feature shape {xx.shape[1:]} does not match "
+                    f"this ParallelInference's {self._expected_shape}"
+                )
+            self._stats["requests"] += 1
+            self._stats["examples"] += xx.shape[0]
+            fut: Optional[Future] = None
+            if (self.mode == InferenceMode.BATCHED
+                    and xx.shape[0] <= self.max_batch_size):
+                fut = Future()
+                self._q.put((xx, fut))
+        if fut is not None:
+            return fut.result()
+        # SEQUENTIAL mode, or an oversized request: run it alone instead of
+        # overshooting a fused group arbitrarily (device work off-lock)
+        return self._run(xx)
+
+    def warmup(self, feature_shape: Optional[Sequence[int]] = None,
+               dtype=np.float32):
+        """Precompile the forward for every bucket before traffic, so the
+        first requests never pay a trace+compile. Fixes the expected
+        feature shape (or uses the one already fixed by a request)."""
+        with self._lock:
+            if feature_shape is not None:
+                fs = tuple(feature_shape)
+                if self._expected_shape is None:
+                    self._expected_shape = fs
+                elif fs != self._expected_shape:
+                    raise ValueError(
+                        f"warmup shape {fs} does not match this "
+                        f"ParallelInference's {self._expected_shape}"
+                    )
+            fs = self._expected_shape
+        if fs is None:
             raise ValueError(
-                f"request feature shape {xx.shape[1:]} does not match this "
-                f"ParallelInference's {self._expected_shape}"
+                "warmup() needs a feature shape: pass feature_shape= or "
+                "serve one request first"
             )
-        if self.mode == InferenceMode.SEQUENTIAL:
-            return self._run(xx)
-        if xx.shape[0] > self.max_batch_size:
-            # oversized request: run it alone instead of overshooting a
-            # fused group arbitrarily
-            return self._run(xx)
-        fut: Future = Future()
-        self._q.put((xx, fut))
-        return fut.result()
+        for b in self.buckets:
+            self._run(np.zeros((b,) + fs, dtype), count=False)
+        return self
+
+    def metrics(self) -> dict:
+        """Point-in-time serving counters. `forward_compiles` is the
+        model's trace count — in steady state it equals the number of
+        distinct post-padding shapes (≤ len(buckets)); growth under
+        traffic means something is defeating the buckets."""
+        with self._lock:
+            m = {
+                "mode": self.mode,
+                "requests": self._stats["requests"],
+                "examples": self._stats["examples"],
+                "batches": self._stats["batches"],
+                "oversized": self._stats["oversized"],
+                "bucket_hits": dict(self._stats["bucket_hits"]),
+            }
+        m["buckets"] = list(self.buckets)
+        m["max_batch_size"] = self.max_batch_size
+        m["batch_timeout_ms"] = self.batch_timeout * 1e3
+        m["queue_depth"] = self._q.qsize() + self._handoff.qsize()
+        m["forward_compiles"] = int(
+            getattr(self.model, "output_compile_count", 0))
+        return m
 
     def shutdown(self):
-        self._shutdown = True
-        if self._worker is not None:
+        with self._lock:
+            if self._shutdown:
+                return
+            self._shutdown = True
+        workers_exited = True
+        if self._collect_t is not None:
+            # the admission lock above guarantees the sentinel is the LAST
+            # item: everything already queued drains normally (served),
+            # then the pipeline exits stage by stage
             self._q.put(None)
-            self._worker.join(timeout=5)
-            # requests that raced the sentinel would otherwise hang their
-            # callers forever — fail them explicitly
+            self._collect_t.join(timeout=10)
+            self._dispatch_t.join(timeout=10)
+            workers_exited = (not self._collect_t.is_alive()
+                              and not self._dispatch_t.is_alive())
+        if not workers_exited:
+            # a slow in-flight forward (e.g. first compile) outlived the
+            # join timeout: the pipeline is still draining and will resolve
+            # every Future itself — sweeping now would steal its sentinel
+            # and fail work it was about to serve
+            return
+        # post-drain sweep: if a worker died abnormally, fail any stranded
+        # Future explicitly instead of hanging its caller forever
+        for q in (self._q, self._handoff):
             while True:
                 try:
-                    item = self._q.get_nowait()
+                    item = q.get_nowait()
                 except queue.Empty:
                     break
-                if item is not None and not item[1].done():
-                    item[1].set_exception(
-                        RuntimeError("ParallelInference shut down")
-                    )
+                futs = ([item[1]] if q is self._q else item[3]) \
+                    if item is not None else []
+                for fut in futs:
+                    if not fut.done():
+                        fut.set_exception(
+                            RuntimeError("ParallelInference shut down"))
 
     # -- internals -----------------------------------------------------------
 
-    def _run(self, xx: np.ndarray):
-        """Sharded forward; non-divisible batches are padded by wrapping
-        and sliced — sharded execution with a stable trace shape instead
-        of a replicated fallback."""
-        n = xx.shape[0]
-        pad = (-n) % self.n_shards
-        if pad:
-            xx = pad_wrap(xx, self.n_shards)
-        out = self.model.output(jax.device_put(xx, batch_sharded(self.mesh)))
-        return out[:n] if pad else out
+    def _bucket_for(self, n: int) -> Optional[int]:
+        for b in self.buckets:
+            if n <= b:
+                return b
+        return None
 
+    def _pad(self, batch: np.ndarray):
+        """Bucket-pad then shard-pad. Returns (padded, n, bucket). The
+        post-padding shape is what the jit trace sees, so the distinct
+        trace count is len({shard-padded bucket sizes}), not the number of
+        distinct request/group sizes."""
+        n = batch.shape[0]
+        b = self._bucket_for(n)
+        if b is not None:
+            batch = pad_wrap(batch, b)
+        # non-divisible sizes are padded by wrapping and sliced — sharded
+        # execution with a stable trace shape instead of a replicated
+        # fallback
+        batch = pad_wrap(batch, self.n_shards)
+        return batch, n, b
+
+    def _count_batch(self, b: Optional[int]):
+        with self._lock:
+            self._stats["batches"] += 1
+            if b is None:
+                self._stats["oversized"] += 1
+            else:
+                self._stats["bucket_hits"][b] += 1
+
+    def _forward_padded(self, padded: np.ndarray, n: int,
+                        b: Optional[int], count: bool = True):
+        """The ONE device forward both paths (caller-thread `_run` and the
+        BATCHED dispatcher) go through: sharded dispatch, host readback,
+        pad rows sliced off. A multi-output ComputationGraph returns a
+        list; the batch slice applies per output, not to the list."""
+        try:
+            out = self.model.output(
+                jax.device_put(padded, batch_sharded(self.mesh)))
+            if isinstance(out, (list, tuple)):
+                out = [np.asarray(o)[:n] for o in out]
+            else:
+                out = np.asarray(out)[:n]
+        except BaseException:
+            with self._lock:
+                if (not self._shape_confirmed
+                        and self._expected_shape == padded.shape[1:]):
+                    # the shape that pinned _expected_shape never ran
+                    # successfully (e.g. a feature width the model
+                    # rejects): unpin, so later well-formed requests can
+                    # re-pin instead of being rejected forever. The
+                    # equality guard keeps a stale failing group from
+                    # clobbering a NEWER pin by a different shape
+                    self._expected_shape = None
+            raise
+        with self._lock:
+            self._shape_confirmed = True
+        if count:  # after the forward: a failed batch is not a served one
+            self._count_batch(b)
+        return out
+
+    @staticmethod
+    def _rows(out, start: int, stop: int):
+        if isinstance(out, list):
+            return [o[start:stop] for o in out]
+        return out[start:stop]
+
+    def _run(self, xx: np.ndarray, count: bool = True):
+        padded, n, b = self._pad(xx)
+        return self._forward_padded(padded, n, b, count)
+
+    # BATCHED pipeline, stage 1: drain + concatenate + pad on the host
     def _collector(self):
-        while not self._shutdown:
-            item = self._q.get()
+        pending = None  # request that would overflow the current group
+        while True:
+            if pending is not None:
+                item, pending = pending, None
+            else:
+                item = self._q.get()
             if item is None:
+                self._handoff.put(None)
                 return
             group = [item]
             count = item[0].shape[0]
@@ -138,19 +355,54 @@ class ParallelInference:
                 except queue.Empty:
                     break
                 if nxt is None:
-                    self._shutdown = True
+                    self._emit(group)
+                    self._handoff.put(None)
+                    return
+                if (count + nxt[0].shape[0] > self.max_batch_size
+                        or nxt[0].shape[1:] != item[0].shape[1:]):
+                    # would overflow max_batch_size (and possibly fall off
+                    # the bucket set) — or, during an unpin/re-pin window
+                    # before the first successful forward, has a different
+                    # feature shape (admission normally guarantees
+                    # uniformity; this makes mixed-shape fusion
+                    # structurally impossible) — start the next group
+                    pending = nxt
                     break
                 group.append(nxt)
                 count += nxt[0].shape[0]
+            self._emit(group)
+
+    def _emit(self, group):
+        """Host-side batch assembly; blocks on the bounded handoff queue
+        when the device is a full group behind (backpressure)."""
+        try:
+            batch = (np.concatenate([g[0] for g in group], axis=0)
+                     if len(group) > 1 else group[0][0])
+            padded, n, b = self._pad(batch)
+        except BaseException as e:  # propagate to all waiting callers
+            for _, fut in group:
+                if not fut.done():
+                    fut.set_exception(e)
+            return
+        self._handoff.put(
+            (padded, n, b, [fut for _, fut in group],
+             [g[0].shape[0] for g in group]))
+
+    # BATCHED pipeline, stage 2: device forward + scatter results
+    def _dispatcher(self):
+        while True:
+            work = self._handoff.get()
+            if work is None:
+                return
+            padded, n, b, futs, sizes = work
             try:
-                batch = np.concatenate([g[0] for g in group], axis=0)
-                out = np.asarray(self._run(batch))
+                out = self._forward_padded(padded, n, b)
                 off = 0
-                for xx, fut in group:
-                    n = xx.shape[0]
-                    fut.set_result(out[off : off + n])
-                    off += n
+                for fut, k in zip(futs, sizes):
+                    if not fut.done():  # shutdown sweep may have failed it
+                        fut.set_result(self._rows(out, off, off + k))
+                    off += k
             except BaseException as e:  # propagate to all waiting callers
-                for _, fut in group:
+                for fut in futs:
                     if not fut.done():
                         fut.set_exception(e)

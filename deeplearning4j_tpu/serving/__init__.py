@@ -1,6 +1,9 @@
 """Serving entry points: k-NN REST server (reference:
-deeplearning4j-nearestneighbor-server) and ParallelInference (parallel/)."""
+deeplearning4j-nearestneighbor-server), model-inference REST server
+(bucketed+pipelined ParallelInference behind POST /predict), and
+ParallelInference itself (parallel/)."""
 
+from deeplearning4j_tpu.serving.inference_server import InferenceServer
 from deeplearning4j_tpu.serving.knnserver import NearestNeighborsServer
 
-__all__ = ["NearestNeighborsServer"]
+__all__ = ["InferenceServer", "NearestNeighborsServer"]

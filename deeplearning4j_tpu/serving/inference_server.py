@@ -1,0 +1,182 @@
+"""REST model-inference server — the serving front-end the reference left
+to users (ParallelInference.java was always embedded behind someone's
+HTTP layer; here the layer ships with the framework, sibling of
+serving/knnserver.py on the same utils/jsonhttp scaffold).
+
+Wraps a MultiLayerNetwork or ComputationGraph in a bucketed, pipelined
+ParallelInference (parallel/inference.py — BATCHED mode fuses concurrent
+requests, pads each fused group to a fixed bucket so only ~log2(B)
+forward traces ever compile, and overlaps host batch assembly with
+device execution). Routes:
+
+    POST /predict  {"features": [[...], ...]}   -> {"predictions": [...]}
+                   (a single flat example is also accepted and returns a
+                    single prediction row; a multi-output graph returns
+                    one predictions entry per output head)
+    GET  /health   -> {"status": "ok", "model": ..., "feature_shape": ...}
+    GET  /metrics  -> {"requests", "examples", "batches", "queue_depth",
+                       "buckets", "bucket_hits", "oversized",
+                       "forward_compiles", "latency_ms":
+                       {"count", "mean_ms", "p50_ms", "p99_ms"}, ...}
+
+Knobs (constructor and CLI flags): `max_batch_size`, `batch_timeout_ms`,
+`buckets`, `warmup_shape` (precompiles every bucket before the port
+opens, so first requests never pay a compile).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+from typing import Optional, Sequence
+
+import numpy as np
+
+from deeplearning4j_tpu.parallel.inference import (
+    InferenceMode,
+    ParallelInference,
+    RequestValidationError,
+)
+from deeplearning4j_tpu.utils.jsonhttp import JsonHttpServer, json_response
+from deeplearning4j_tpu.utils.latency import LatencyTracker
+
+
+class InferenceServer:
+    def __init__(
+        self,
+        model,
+        port: int = 0,
+        mesh=None,
+        inference_mode: str = InferenceMode.BATCHED,
+        max_batch_size: int = 64,
+        batch_timeout_ms: float = 2.0,
+        buckets: Optional[Sequence[int]] = None,
+        warmup_shape: Optional[Sequence[int]] = None,
+    ):
+        self.inference = ParallelInference(
+            model, mesh, inference_mode, max_batch_size, batch_timeout_ms,
+            buckets,
+        )
+        if warmup_shape is not None:
+            self.inference.warmup(warmup_shape)
+        self.latency = LatencyTracker()
+        self._server = JsonHttpServer(get=self._get, post=self._post,
+                                      port=port)
+
+    @property
+    def port(self) -> int:
+        return self._server.port
+
+    def metrics(self) -> dict:
+        m = self.inference.metrics()
+        # JSON object keys must be strings; bucket sizes are ints
+        m["bucket_hits"] = {str(k): v for k, v in m["bucket_hits"].items()}
+        m["latency_ms"] = self.latency.snapshot()
+        return m
+
+    # -- request handling ----------------------------------------------------
+
+    def _get(self, path, body, headers):
+        if path == "/health":
+            shape = self.inference._expected_shape
+            return json_response({
+                "status": "ok",
+                "model": type(self.inference.model).__name__,
+                "feature_shape": None if shape is None else list(shape),
+            })
+        if path == "/metrics":
+            return json_response(self.metrics())
+        return None
+
+    def _post(self, path, body, headers):
+        if path != "/predict":
+            return None
+        req = json.loads(body or b"{}")
+        if "features" not in req:
+            return json_response({"error": "missing 'features'"}, 400)
+        try:
+            feats = np.asarray(req["features"], np.float32)
+        except (ValueError, TypeError) as e:  # ragged / non-numeric
+            return json_response({"error": f"bad features: {e}"}, 400)
+        if feats.ndim == 0 or feats.size == 0:
+            return json_response(
+                {"error": "features must be a non-empty example array"}, 400)
+        single = feats.ndim == 1
+        if single:
+            feats = feats[None]
+        t0 = time.perf_counter()
+        try:
+            out = self.inference.output(feats)
+        except RequestValidationError as e:  # the client's fault
+            return json_response({"error": str(e)}, 400)
+        except Exception as e:
+            # anything else (shutdown race, model/XLA failure — including
+            # server-side ValueErrors) is a server fault: 500, so
+            # clients/load-balancers retry or fail over (JsonHttpServer's
+            # catch-all would mislabel it a 400)
+            return json_response({"error": f"{type(e).__name__}: {e}"}, 500)
+        self.latency.record(time.perf_counter() - t0)
+        if isinstance(out, list):  # multi-output graph: one entry per head
+            preds = [np.asarray(o)[0].tolist() if single
+                     else np.asarray(o).tolist() for o in out]
+        else:
+            out = np.asarray(out)
+            preds = (out[0] if single else out).tolist()
+        return json_response({"predictions": preds})
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> int:
+        return self._server.start()
+
+    def stop(self):
+        self._server.stop()
+        self.inference.shutdown()
+
+    def join(self):
+        self._server.join()
+
+
+def main(argv=None):
+    """CLI: serve a saved model zip / Keras h5 over REST.
+
+        python -m deeplearning4j_tpu.serving.inference_server \
+            --modelPath model.zip --port 9100 --maxBatchSize 64 \
+            --batchTimeoutMs 2 --warmupShape 784
+    """
+    ap = argparse.ArgumentParser(description="model inference REST server")
+    ap.add_argument("--modelPath", required=True)
+    ap.add_argument("--port", type=int, default=9100)
+    ap.add_argument("--maxBatchSize", type=int, default=64)
+    ap.add_argument("--batchTimeoutMs", type=float, default=2.0)
+    ap.add_argument("--buckets", default=None,
+                    help="comma-separated bucket sizes (default: powers of "
+                         "two up to maxBatchSize)")
+    ap.add_argument("--warmupShape", default=None,
+                    help="comma-separated feature shape to precompile all "
+                         "buckets before the port opens, e.g. 784 or 28,28,1")
+    args = ap.parse_args(argv)
+    from deeplearning4j_tpu.cli import guess_and_load_model
+
+    model = guess_and_load_model(args.modelPath)
+    buckets = (None if args.buckets is None
+               else [int(b) for b in args.buckets.split(",")])
+    warmup = (None if args.warmupShape is None
+              else tuple(int(d) for d in args.warmupShape.split(",")))
+    server = InferenceServer(
+        model, port=args.port, max_batch_size=args.maxBatchSize,
+        batch_timeout_ms=args.batchTimeoutMs, buckets=buckets,
+        warmup_shape=warmup,
+    )
+    port = server.start()
+    print(f"inference server listening on :{port} "
+          f"(buckets {server.inference.buckets})")
+    try:
+        server.join()
+    except KeyboardInterrupt:
+        server.stop()
+
+
+if __name__ == "__main__":
+    main()
