@@ -225,6 +225,38 @@ def cmd_report(args) -> int:
     return 0
 
 
+def cmd_profile(args) -> int:
+    """Aggregate a captured jax-profiler trace directory into the op-family
+    device-time breakdown used by the PROFILE_*.md tables; --json exports
+    it as a machine-readable artifact so bench runs attach breakdowns
+    mechanically instead of by hand (utils/profiler.py)."""
+    from deeplearning4j_tpu.utils.profiler import (
+        family_summary,
+        format_summary,
+        op_summary,
+        write_profile_json,
+    )
+
+    if args.json:  # single parse — the xplane decode dominates runtime
+        payload = write_profile_json(args.log_dir, args.json,
+                                     top_ops=args.top)
+        if not payload["families_ms"]:
+            print(f"no device ops found in {args.log_dir} (missing trace "
+                  f"or xplane proto unavailable)", file=sys.stderr)
+        print(f"wrote {args.json} ({len(payload['families_ms'])} op "
+              f"families, {payload['total_device_sec'] * 1e3:.3f} ms device)")
+        return 0
+    rows = op_summary(args.log_dir, top=1_000_000)
+    if not rows:
+        print(f"no device ops found in {args.log_dir} (missing trace or "
+              f"xplane proto unavailable)", file=sys.stderr)
+    print("device time by op family:")
+    for fam, sec in family_summary(rows)[:args.top]:
+        print(f"  {sec * 1e3:9.3f} ms  {fam}")
+    print(format_summary(rows[:args.top]))
+    return 0
+
+
 def main(argv=None) -> int:
     # honor JAX_PLATFORMS even when a sitecustomize imported jax before
     # this process's env was consulted (config update beats env once the
@@ -294,6 +326,16 @@ def main(argv=None) -> int:
                    help="session id (default: newest)")
     r.add_argument("--title", default="training report")
     r.set_defaults(fn=cmd_report)
+
+    p = sub.add_parser(
+        "profile",
+        help="op-family device-time breakdown from a jax-profiler trace")
+    p.add_argument("--log-dir", required=True,
+                   help="directory a jax.profiler trace was captured into")
+    p.add_argument("--json", default=None,
+                   help="write the aggregation to this path as JSON")
+    p.add_argument("--top", type=int, default=40)
+    p.set_defaults(fn=cmd_profile)
 
     args = ap.parse_args(argv)
     return args.fn(args)

@@ -25,6 +25,7 @@ from deeplearning4j_tpu.nn.layers.core import apply_dropout
 from deeplearning4j_tpu.nn.layers.registry import LayerContext, register_layer
 from deeplearning4j_tpu.nn.weights import init_weights
 from deeplearning4j_tpu.ops.activations import apply_activation
+from deeplearning4j_tpu.ops.helpers import HelperError, get_helper
 
 _DIMS2D = ("NHWC", "HWIO", "NHWC")
 
@@ -53,14 +54,41 @@ def conv_init(key, conf: L.ConvolutionLayer, dtype):
 
 def conv_forward(conf: L.ConvolutionLayer, params, x, ctx: LayerContext):
     x = apply_dropout(x, conf.dropout, ctx)
-    z = lax.conv_general_dilated(
-        x,
-        params["W"].astype(x.dtype),
-        window_strides=tuple(int(s) for s in conf.stride),
-        padding=_padding_2d(conf),
-        rhs_dilation=tuple(int(d) for d in conf.dilation),
-        dimension_numbers=_DIMS2D,
+    strides = tuple(int(s) for s in conf.stride)
+    # vendor-kernel plugin point (the CudnnConvolutionHelper analog): a
+    # registered conv kernel — e.g. the Pallas conv+BN-stats epilogue
+    # fusion (ops/pallas_conv_bn.py) — takes over when it supports this
+    # configuration; a helper that raises is disabled by the SPI and the
+    # built-in XLA lowering below runs instead
+    z = None
+    helper = get_helper(
+        "conv2d",
+        kernel=tuple(int(k) for k in conf.kernel_size),
+        stride=strides,
+        dilation=tuple(int(d) for d in conf.dilation),
+        same=conf.convolution_mode == ConvolutionMode.SAME,
+        has_bias=conf.has_bias,
+        activation=conf.activation,
+        dtype=x.dtype,
+        n_in=int(x.shape[-1]),
+        n_out=int(conf.n_out),
+        x_shape=tuple(int(d) for d in x.shape),
+        training=ctx.training,
     )
+    if helper is not None:
+        try:
+            z = helper(x, params["W"].astype(x.dtype), strides=strides)
+        except HelperError:
+            z = None
+    if z is None:
+        z = lax.conv_general_dilated(
+            x,
+            params["W"].astype(x.dtype),
+            window_strides=strides,
+            padding=_padding_2d(conf),
+            rhs_dilation=tuple(int(d) for d in conf.dilation),
+            dimension_numbers=_DIMS2D,
+        )
     if conf.has_bias:
         z = z + params["b"].astype(z.dtype)
     return apply_activation(conf.activation, z, key=ctx.rng, training=ctx.training), None

@@ -17,6 +17,7 @@ from jax import lax
 from deeplearning4j_tpu.nn.conf import layers as L
 from deeplearning4j_tpu.nn.layers.registry import LayerContext, register_layer
 from deeplearning4j_tpu.ops.activations import apply_activation
+from deeplearning4j_tpu.ops.helpers import HelperError, get_helper
 
 
 # -- batch normalization -----------------------------------------------------
@@ -198,7 +199,21 @@ def batchnorm_forward(conf: L.BatchNormalization, params, x, ctx: LayerContext):
             beta = jnp.full((c,), conf.beta, _acc_dtype(x.dtype))
         else:
             gamma, beta = params["gamma"], params["beta"]
-        y, mean, var = _bn_train(x, gamma, beta, eps)
+        # vendor-kernel plugin point (the CudnnBatchNormalizationHelper
+        # analog): when this input is a stashed conv+stats-epilogue output
+        # (ops/pallas_conv_bn.py), the fused normalize kernel consumes the
+        # precomputed statistics — one read of x instead of two. The probe
+        # matches by tensor identity, so anything else falls through to
+        # the built-in fused path below.
+        y = mean = var = None
+        helper = get_helper("batch_norm", x=x, training=True)
+        if helper is not None:
+            try:
+                y, mean, var = helper(x, gamma, beta, eps)
+            except HelperError:
+                y = None
+        if y is None:
+            y, mean, var = _bn_train(x, gamma, beta, eps)
         d = conf.decay
         mean = lax.stop_gradient(mean)
         var = lax.stop_gradient(var)

@@ -87,8 +87,10 @@ def _scan_lstm(conf, params, x, ctx, peephole: bool, prefix: str = "", reverse: 
         c0 = jnp.zeros((bsz, H), x.dtype)
 
     # vendor-kernel plugin point (the CudnnHelper analog): a registered
-    # fused-sequence kernel takes over when it supports this configuration
-    from deeplearning4j_tpu.ops.helpers import get_helper
+    # fused-sequence kernel takes over when it supports this configuration;
+    # a kernel that raises at trace time is disabled by the SPI
+    # (HelperError) and the scan path below runs instead
+    from deeplearning4j_tpu.ops.helpers import HelperError, get_helper
 
     helper = get_helper(
         "lstm_sequence", peephole=peephole, mask=ctx.mask,
@@ -102,8 +104,12 @@ def _scan_lstm(conf, params, x, ctx, peephole: bool, prefix: str = "", reverse: 
         else:
             zero = jnp.zeros((H,), x.dtype)
             pv = (zero, zero, zero)
-        ys, hF, cF = helper(xg_t, RW.astype(x.dtype), *pv, h0, c0)
-        return jnp.swapaxes(ys, 0, 1), (hF, cF)
+        try:
+            ys, hF, cF = helper(xg_t, RW.astype(x.dtype), *pv, h0, c0)
+        except HelperError:
+            pass  # fall through to the built-in scan
+        else:
+            return jnp.swapaxes(ys, 0, 1), (hF, cF)
 
     mask = ctx.mask
     if mask is not None:

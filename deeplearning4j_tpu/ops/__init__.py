@@ -8,6 +8,7 @@ Pallas functions that XLA fuses into whole-step programs.
 from deeplearning4j_tpu.ops.activations import Activation, activation_fn, register_activation
 from deeplearning4j_tpu.ops.losses import LossFunction, loss_value, register_loss
 from deeplearning4j_tpu.ops.helpers import (
+    HelperError,
     get_helper,
     helper_names,
     register_helper,
@@ -16,5 +17,10 @@ from deeplearning4j_tpu.ops.helpers import (
 
 try:  # vendor kernels register themselves; absence must never break ops/
     from deeplearning4j_tpu.ops import pallas_lstm  # noqa: F401
+except Exception:  # pragma: no cover - pallas unavailable on this backend
+    pass
+
+try:
+    from deeplearning4j_tpu.ops import pallas_conv_bn  # noqa: F401
 except Exception:  # pragma: no cover - pallas unavailable on this backend
     pass

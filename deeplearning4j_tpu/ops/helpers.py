@@ -19,6 +19,13 @@ from typing import Callable, Dict, Optional
 logger = logging.getLogger("deeplearning4j_tpu")
 
 
+class HelperError(RuntimeError):
+    """A registered helper fn raised at trace/run time. The helper has
+    already been disabled and the failure logged; callers catch this and
+    retry their built-in lowering (the reference behaves the same way: a
+    cuDNN helper that throws is dropped and the layer falls back)."""
+
+
 @dataclasses.dataclass
 class Helper:
     name: str
@@ -45,7 +52,13 @@ def register_helper(op: str, fn: Callable,
 
 def get_helper(op: str, **ctx) -> Optional[Callable]:
     """The helper's fn if one is registered, enabled, and supports this
-    call context; else None (caller uses its built-in path)."""
+    call context; else None (caller uses its built-in path).
+
+    The returned callable is guarded: a helper fn that raises (e.g. a
+    Pallas lowering failure at trace time) is logged and DISABLED, and the
+    call raises HelperError so the caller retries its built-in path —
+    without the guard a broken kernel would kill the layer with no
+    fallback even though the probe passed."""
     h = _HELPERS.get(op)
     if h is None or not h.enabled:
         return None
@@ -55,12 +68,32 @@ def get_helper(op: str, **ctx) -> Optional[Callable]:
     except Exception as e:  # a broken probe must never kill the fallback
         logger.warning("helper %s probe failed: %s", h.name, e)
         return None
-    return h.fn
+
+    def guarded(*args, **kwargs):
+        try:
+            return h.fn(*args, **kwargs)
+        except Exception as e:
+            h.enabled = False
+            logger.warning(
+                "helper %s (op %s) raised %s: %s — helper disabled, "
+                "falling back to the built-in path", h.name, op,
+                type(e).__name__, e)
+            raise HelperError(f"helper {h.name} failed: {e}") from e
+
+    return guarded
 
 
 def set_helper_enabled(op: str, enabled: bool) -> None:
     if op in _HELPERS:
         _HELPERS[op].enabled = bool(enabled)
+
+
+def helper_enabled(op: str) -> Optional[bool]:
+    """Current enabled state (None when no helper is registered) — lets
+    callers snapshot/restore the kill switch and detect a mid-run
+    auto-disable (a helper fn that raised)."""
+    h = _HELPERS.get(op)
+    return None if h is None else h.enabled
 
 
 def helper_names() -> Dict[str, str]:

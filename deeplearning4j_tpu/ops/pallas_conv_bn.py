@@ -1,0 +1,557 @@
+"""Pallas conv + BN-statistics epilogue fusion — the CudnnConvolutionHelper/
+CudnnBatchNormalizationHelper pair for the ResNet trunk.
+
+Why: PROFILE_resnet50.md shows the train step is bandwidth-bound, with
+16.4 ms of a 48.8 ms step spent on batch-norm statistics/normalization
+traffic over the residual trunk (`convert_reduce_fusion` = 25.8 ms/step).
+XLA materializes each conv output to HBM, then re-reads the full tensor
+for the per-channel statistics reduction, then re-reads it AGAIN for the
+normalize. This module closes one of those reads: the conv kernel computes
+per-channel sum / sum-of-squares in f32 as an epilogue over each output
+tile while it is still in VMEM, so the stats cost no extra HBM traffic at
+all; a second fused normalize(+ReLU) kernel then performs the one
+remaining read.
+
+Two helper slots (ops/helpers.py), mirroring the reference's plugin pair
+(CudnnConvolutionHelper.java:345, BatchNormalizationHelper.java:29):
+
+- "conv2d":     `_conv2d_helper` — conv forward with the stats epilogue.
+  The stats ride to the downstream BatchNormalization layer through a
+  producer→consumer stash keyed by tensor identity: within one trace the
+  conv's output object IS the BN layer's input object (compgraph passes
+  activations through untouched), so the match is exact and anything in
+  between (an activation, a residual add) breaks it safely.
+- "batch_norm": `_bn_helper` — fused normalize from the stashed stats,
+  with a deferred-ReLU hook: when the very next layer is a ReLU
+  ActivationLayer, it swaps in the normalize+ReLU variant of the kernel
+  and the plain-normalize pallas_call is dead-code-eliminated by XLA.
+
+Scope (checked by the probes; everything else falls back silently to the
+XLA lowering, exactly like the cuDNN checkSupported fallback): NHWC,
+bf16 on real TPU, training mode, bias-free identity-activation convs with
+kernel 1x1 (stride 1 or 2) or 3x3 (stride 1), SAME padding, no dilation
+— the shapes of every ResNet bottleneck conv except the 7x7 stem and the
+three stage-entry 3x3/s2 convs.
+
+Backward is a hand-written custom_vjp pair: the conv pullback is the
+standard pair of transposed XLA convolutions (jax.linear_transpose of the
+reference lowering — already MXU-shaped; Pallas buys nothing there), and
+the BN pullback reuses the fused-BN VJP structure of nn/layers/norm.py
+(per-channel coefficients in the f32 accumulator dtype, every full-size
+tensor in x.dtype). The stats outputs are stop_gradient'ed at the stash:
+the BN backward's dx is the TOTAL derivative including the statistics
+paths (same composite as norm.py's `_bn_train`), so routing any cotangent
+through the stats tensors as well would double-count.
+"""
+
+from __future__ import annotations
+
+import logging
+from collections import deque
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+_INTERPRET = False  # flipped by tests on CPU (same pattern as pallas_lstm)
+
+_DIMS2D = ("NHWC", "HWIO", "NHWC")
+
+
+# -- producer→consumer stashes ----------------------------------------------
+#
+# Entries are matched by `is` on the traced value, so they can only ever
+# connect a conv to the BN (or a BN to the ReLU) that consumes that exact
+# tensor inside the same trace. Bounded deques: unmatched entries (a conv
+# whose consumer is not a BN, an abandoned trace) age out instead of
+# accumulating tracer references.
+
+_STATS_STASH: deque = deque(maxlen=8)
+_RELU_STASH: deque = deque(maxlen=8)
+
+
+def _stash_pop(dq: deque, x):
+    """Remove and return the entry whose key tensor IS x. Removal is by
+    index — deque.remove would compare entries with ==, which on traced
+    arrays of unequal shapes raises instead of answering False."""
+    for i, entry in enumerate(dq):
+        if entry[0] is x:
+            del dq[i]
+            return entry
+    return None
+
+
+def _stash_stats(y, s1, s2) -> None:
+    _STATS_STASH.append((y, s1, s2))
+
+
+def take_stats(x):
+    """(sum, sum_sq) f32 per-channel stats stashed for exactly this tensor,
+    removing the entry; None when x is not a stashed conv output."""
+    entry = _stash_pop(_STATS_STASH, x)
+    return None if entry is None else (entry[1], entry[2])
+
+
+def peek_stats(x) -> bool:
+    return any(entry[0] is x for entry in _STATS_STASH)
+
+
+def _stash_relu(y, thunk) -> None:
+    _RELU_STASH.append((y, thunk))
+
+
+def take_fused_relu(x):
+    """The normalize+ReLU variant of a stashed BN output, or None. The
+    plain-normalize pallas_call that produced x becomes dead code once its
+    only consumer switches to the fused variant — XLA eliminates it."""
+    entry = _stash_pop(_RELU_STASH, x)
+    if entry is None:
+        return None
+    try:
+        return entry[1]()
+    except Exception as e:  # never let the fusion shortcut kill a layer
+        logger.warning("fused BN+ReLU thunk failed (%s); applying "
+                       "plain ReLU instead", e)
+        return None
+
+
+# -- tiling helpers ----------------------------------------------------------
+
+def _row_tile(m: int, cap: int = 512) -> int:
+    """Largest power-of-two row tile <= cap dividing m (ResNet row counts
+    are highly 2-adic: N*H*W = 128*56*56 etc; tiny test shapes land on a
+    smaller divisor, worst case 1)."""
+    t = cap
+    while t > 1 and m % t:
+        t //= 2
+    return t
+
+
+def _acc_dtype(dtype):
+    """f32 accumulators, or f64 when the whole check runs f64 (the
+    gradient-check configuration) — matches nn/layers/norm.py."""
+    return jnp.promote_types(dtype, jnp.float32)
+
+
+# -- 1x1 conv (pointwise matmul) with stats epilogue -------------------------
+
+def _mm_stats_kernel(x_ref, w_ref, y_ref, s1_ref, s2_ref):
+    t = pl.program_id(0)
+
+    @pl.when(t == 0)
+    def _():
+        s1_ref[:] = jnp.zeros_like(s1_ref)
+        s2_ref[:] = jnp.zeros_like(s2_ref)
+
+    acc_dt = s1_ref.dtype
+    y = jnp.dot(x_ref[:], w_ref[:], preferred_element_type=acc_dt)
+    yb = y.astype(y_ref.dtype)
+    y_ref[:] = yb
+    # Epilogue over the tile while it is still in VMEM. Statistics are of
+    # the STORED (rounded) tensor — what the normalize will actually read
+    # — not the f32 pre-rounding accumulator.
+    yf = yb.astype(acc_dt)
+    s1_ref[:] += jnp.sum(yf, axis=0, keepdims=True)
+    s2_ref[:] += jnp.sum(yf * yf, axis=0, keepdims=True)
+
+
+def _mm_stats_call(x2, w2):
+    m, cin = x2.shape
+    cout = w2.shape[1]
+    acc = _acc_dtype(x2.dtype)
+    # big-channel shapes get a smaller row tile so weights + double-buffered
+    # row tiles stay inside VMEM (probe re-checks the same budget)
+    tm = _row_tile(m, 128 if cin * cout >= 1024 * 1024 else 512)
+    y2, s1, s2 = pl.pallas_call(
+        _mm_stats_kernel,
+        grid=(m // tm,),
+        in_specs=[
+            pl.BlockSpec((tm, cin), lambda t: (t, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((cin, cout), lambda t: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((tm, cout), lambda t: (t, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, cout), lambda t: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, cout), lambda t: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((m, cout), x2.dtype),
+            jax.ShapeDtypeStruct((1, cout), acc),
+            jax.ShapeDtypeStruct((1, cout), acc),
+        ],
+        interpret=_INTERPRET,
+    )(x2, w2)
+    return y2, s1, s2
+
+
+# -- 3x3 stride-1 SAME conv with stats epilogue ------------------------------
+
+def _c3_stats_kernel(x_ref, w_ref, y_ref, s1_ref, s2_ref):
+    n = pl.program_id(0)
+
+    @pl.when(n == 0)
+    def _():
+        s1_ref[:] = jnp.zeros_like(s1_ref)
+        s2_ref[:] = jnp.zeros_like(s2_ref)
+
+    acc_dt = s1_ref.dtype
+    h, w = y_ref.shape[1], y_ref.shape[2]
+    cout = y_ref.shape[3]
+    acc = jnp.zeros((h, w, cout), acc_dt)
+    x = x_ref[0]
+    # 9 shifted whole-image dots accumulated in VMEM. The SAME-padding
+    # halo is handled by clipping each shift to its valid region (static
+    # slices) instead of pre-padding the input — a jnp.pad outside the
+    # kernel would materialize a full padded copy to HBM, spending the
+    # very read the stats epilogue saves.
+    for a in (-1, 0, 1):
+        i0, i1 = max(0, -a), h - max(0, a)
+        for b in (-1, 0, 1):
+            j0, j1 = max(0, -b), w - max(0, b)
+            part = lax.dot_general(
+                x[i0 + a:i1 + a, j0 + b:j1 + b, :],
+                w_ref[a + 1, b + 1],
+                (((2,), (0,)), ((), ())),
+                preferred_element_type=acc_dt,
+            )
+            # zero-extend the clipped partial back to (h, w) and add —
+            # in-register pad; .at[...].add would capture index constants
+            # the kernel tracer rejects
+            acc = acc + lax.pad(
+                part, jnp.asarray(0, acc_dt),
+                ((i0, h - i1, 0), (j0, w - j1, 0), (0, 0, 0)))
+    yb = acc.astype(y_ref.dtype)
+    y_ref[0] = yb
+    yf = yb.astype(acc_dt).reshape(h * w, cout)
+    s1_ref[:] += jnp.sum(yf, axis=0, keepdims=True)
+    s2_ref[:] += jnp.sum(yf * yf, axis=0, keepdims=True)
+
+
+def _c3_stats_call(x, w):
+    n, h, wd, cin = x.shape
+    cout = w.shape[3]
+    acc = _acc_dtype(x.dtype)
+    y, s1, s2 = pl.pallas_call(
+        _c3_stats_kernel,
+        grid=(n,),
+        in_specs=[
+            pl.BlockSpec((1, h, wd, cin), lambda i: (i, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((3, 3, cin, cout), lambda i: (0, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, h, wd, cout), lambda i: (i, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, cout), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, cout), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, h, wd, cout), x.dtype),
+            jax.ShapeDtypeStruct((1, cout), acc),
+            jax.ShapeDtypeStruct((1, cout), acc),
+        ],
+        interpret=_INTERPRET,
+    )(x, w)
+    return y, s1, s2
+
+
+# -- fused conv + stats op (custom_vjp) --------------------------------------
+
+@partial(jax.custom_vjp, nondiff_argnums=(2,))
+def conv2d_bn_stats(x, w, strides):
+    """NHWC conv (SAME, bias-free) returning (y, sum, sum_sq) where the
+    per-channel f32 statistics are computed as a VMEM epilogue of the conv
+    output tiles — zero extra HBM traffic for the reduction.
+
+    x: [N,H,W,Cin]; w: [kh,kw,Cin,Cout] with (kh,kw) in {(1,1),(3,3)};
+    strides: static (sh,sw) — (1,1), or (2,2) for 1x1 kernels.
+
+    The statistics outputs carry NO gradient (see module docstring: the
+    paired `bn_apply` backward computes the total dx including the stats
+    paths). Consume them via the Helper SPI wiring or stop_gradient them.
+    """
+    y, s1, s2 = _conv_fwd_impl(x, w, strides)
+    return y, s1, s2
+
+
+def _conv_fwd_impl(x, w, strides):
+    kh, kw = int(w.shape[0]), int(w.shape[1])
+    cout = int(w.shape[3])
+    if (kh, kw) == (1, 1):
+        sh, sw = strides
+        if (sh, sw) != (1, 1):
+            # SAME 1x1/s: output pixel (i,j) samples x[i*s, j*s] exactly
+            x = x[:, ::sh, ::sw, :]
+        n, h, wd, cin = x.shape
+        y2, s1, s2 = _mm_stats_call(x.reshape(n * h * wd, cin),
+                                    w.reshape(cin, cout))
+        return y2.reshape(n, h, wd, cout), s1[0], s2[0]
+    # 3x3 stride 1 SAME: full image per grid step, halo clipped in-kernel
+    y, s1, s2 = _c3_stats_call(x, w)
+    return y, s1[0], s2[0]
+
+
+def _conv_fwd(x, w, strides):
+    out = _conv_fwd_impl(x, w, strides)
+    return out, (x, w)
+
+
+def _conv_bwd(strides, res, cts):
+    """Pullback = the two transposed convolutions of the reference XLA
+    lowering (linear_transpose instantiates no forward pass). ds1/ds2 are
+    structurally zero — the stats are stop_gradient'ed at the stash and
+    bn_apply's dx is the total derivative — so they are dropped here."""
+    x, w = res
+    dy, _, _ = cts
+
+    def conv_x(xx):
+        return lax.conv_general_dilated(
+            xx, w, window_strides=strides, padding="SAME",
+            dimension_numbers=_DIMS2D)
+
+    def conv_w(ww):
+        return lax.conv_general_dilated(
+            x, ww, window_strides=strides, padding="SAME",
+            dimension_numbers=_DIMS2D)
+
+    dx, = jax.linear_transpose(conv_x, x)(dy)
+    dw, = jax.linear_transpose(conv_w, w)(dy)
+    return dx, dw
+
+
+conv2d_bn_stats.defvjp(_conv_fwd, _conv_bwd)
+
+
+# -- fused normalize(+ReLU) consumer (custom_vjp) ----------------------------
+
+def _norm_kernel_relu(x_ref, mb_ref, sc_ref, sh_ref, y_ref):
+    xc = x_ref[:] - mb_ref[:]
+    y = xc * sc_ref[:].astype(x_ref.dtype) + sh_ref[:].astype(x_ref.dtype)
+    y_ref[:] = jnp.maximum(y, jnp.zeros_like(y))
+
+
+def _norm_kernel(x_ref, mb_ref, sc_ref, sh_ref, y_ref):
+    xc = x_ref[:] - mb_ref[:]
+    y_ref[:] = xc * sc_ref[:].astype(x_ref.dtype) \
+        + sh_ref[:].astype(x_ref.dtype)
+
+
+def _norm_call(x2, mean_b, scale, shift, relu):
+    """y = (x - mean_b)*scale + shift, one fused pass. Centered BEFORE the
+    scale exactly like norm.py's `_bn_train`: x - bf16(mean) is exact near
+    the mean (Sterbenz), so low-precision rounding applies to the
+    deviation, not to mean*scale-sized intermediates."""
+    m, c = x2.shape
+    tm = _row_tile(m)
+    return pl.pallas_call(
+        _norm_kernel_relu if relu else _norm_kernel,
+        grid=(m // tm,),
+        in_specs=[
+            pl.BlockSpec((tm, c), lambda t: (t, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, c), lambda t: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, c), lambda t: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, c), lambda t: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((tm, c), lambda t: (t, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((m, c), x2.dtype),
+        interpret=_INTERPRET,
+    )(x2, mean_b, scale, shift)
+
+
+def _col_sums(x2, acc_dt):
+    """Column sums of [n, c] with accumulator-dtype accumulation via a dot
+    against ones — the MXU form norm.py's `_sum_to_f32` uses, generalized
+    to f64 for the gradient-check configuration."""
+    ones = jnp.ones((x2.shape[0],), x2.dtype)
+    return lax.dot_general(ones, x2, (((0,), (0,)), ((), ())),
+                           preferred_element_type=acc_dt)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+def bn_apply(x, s1, s2, gamma, beta, eps, n, relu):
+    """Training-mode batch norm from precomputed raw moments: one fused
+    read of x (normalize + optional ReLU in a single Pallas pass) instead
+    of XLA's reduce-then-normalize double read. Returns (y, mean, var)
+    exactly like norm.py's `_bn_train`; mean/var feed the running-EMA
+    state only. n = number of reduced elements (x.size / channels);
+    eps/n/relu are static."""
+    out, _ = _bn_fwd(x, s1, s2, gamma, beta, eps, n, relu)
+    return out
+
+
+def _bn_fwd(x, s1, s2, gamma, beta, eps, n, relu):
+    acc = _acc_dtype(x.dtype)
+    c = x.shape[-1]
+    mean = s1.astype(acc) / n
+    var = jnp.maximum(s2.astype(acc) / n - mean * mean, 0.0)
+    inv = lax.rsqrt(var + eps)
+    scale = gamma.astype(acc) * inv
+    # centered application (norm.py's bf16 form): y = (x - bf16(mean))
+    # * scale + (beta - delta*scale), with delta the mean's rounding error
+    mean_b = mean.astype(x.dtype)
+    delta = mean - mean_b.astype(acc)
+    shift = beta.astype(acc) - delta * scale
+    y2 = _norm_call(x.reshape(n, c), mean_b[None, :], scale[None, :],
+                    shift[None, :], relu)
+    y = y2.reshape(x.shape)
+    return (y, mean, var), (x, gamma, mean, inv, y)
+
+
+def _bn_bwd(eps, n, relu, res, cts):
+    """The fused-BN VJP of nn/layers/norm.py (`_bn_train_bwd`), extended
+    with the ReLU gate: per-channel coefficients in the accumulator dtype,
+    every full-size tensor in x.dtype; bf16 uses the centered reduction
+    (x - bf16(mean), exact by Sterbenz near the mean) so sum_gx never
+    cancels catastrophically. mean/var cotangents are dropped — they feed
+    the non-trainable running EMA, as in the reference."""
+    g, _, _ = cts
+    x, gamma, mean, inv, y = res
+    g = g.astype(x.dtype)
+    if relu:
+        g = jnp.where(y > 0, g, jnp.zeros_like(g))
+    c = x.shape[-1]
+    acc = _acc_dtype(x.dtype)
+    if x.dtype == jnp.bfloat16:
+        mean_b = mean.astype(x.dtype)
+        delta = mean - mean_b.astype(acc)
+        xc = x - jnp.broadcast_to(mean_b, x.shape)
+        g2 = g.reshape(n, c)
+        x2 = xc.reshape(n, c)
+        sum_g = _col_sums(g2, acc)
+        sum_gx = _col_sums(g2 * x2, acc) - delta * sum_g
+        center = delta
+        x_for_dx = xc
+    else:
+        g2 = g.astype(acc).reshape(n, c)
+        x2 = x.astype(acc).reshape(n, c)
+        sum_g = jnp.sum(g2, axis=0)
+        sum_gx = jnp.sum(g2 * x2, axis=0) - mean * sum_g
+        center = mean
+        x_for_dx = x
+    dgamma = (inv * sum_gx).astype(gamma.dtype)
+    dbeta = sum_g.astype(gamma.dtype)
+    gamma_f = gamma.astype(acc)
+    c1 = gamma_f * inv
+    c3 = gamma_f * inv * inv * inv * sum_gx / n
+    c0 = -(c1 * sum_g / n) + c3 * center
+    dx = (c1.astype(x.dtype) * g - c3.astype(x.dtype) * x_for_dx
+          + c0.astype(x.dtype))
+    # dx is the TOTAL derivative (elementwise + both statistics paths);
+    # the raw-moment inputs therefore receive zero cotangent.
+    zs = jnp.zeros((c,), _acc_dtype(x.dtype))
+    return dx, zs, zs, dgamma, dbeta
+
+
+bn_apply.defvjp(_bn_fwd, _bn_bwd)
+
+
+# -- Helper SPI wiring -------------------------------------------------------
+
+_VMEM_BUDGET = 12 * 1024 * 1024  # leave headroom under the ~16MB/core VMEM
+
+
+def _conv_vmem_ok(kernel, x_shape, n_in, n_out, itemsize) -> bool:
+    if kernel == (3, 3):
+        h, w = x_shape[1], x_shape[2]
+        slab = h * w * n_in * itemsize  # one full input image
+        out = h * w * n_out * itemsize
+        accf = h * w * n_out * 4
+        wgt = 9 * n_in * n_out * itemsize
+        return 2 * (slab + out) + accf + wgt <= _VMEM_BUDGET
+    wgt = n_in * n_out * itemsize
+    tm = 128 if n_in * n_out >= 1024 * 1024 else 512
+    tiles = 2 * tm * (n_in + n_out) * itemsize
+    return wgt + tiles <= _VMEM_BUDGET
+
+
+def conv_supported(*, kernel, stride, dilation, same, has_bias, activation,
+                   dtype, n_in, n_out, x_shape, training, **_):
+    """Probe for the "conv2d" slot. Whitelists exactly the ResNet-stage
+    conv shapes the kernels cover; everything else (stem 7x7, stage-entry
+    3x3/s2, biased or activated convs, inference) falls back to the XLA
+    lowering — the cuDNN checkSupported pattern."""
+    if not training or has_bias or not same:
+        return False
+    if activation not in (None, "identity"):
+        return False
+    if tuple(dilation) != (1, 1):
+        return False
+    k, s = tuple(kernel), tuple(stride)
+    if k == (1, 1):
+        if s not in ((1, 1), (2, 2)):
+            return False
+    elif k == (3, 3):
+        if s != (1, 1):
+            return False
+    else:
+        return False
+    if _INTERPRET:  # CPU correctness tests: any float dtype / tiny channels
+        return jnp.issubdtype(dtype, jnp.floating)
+    if jax.default_backend() != "tpu" or dtype != jnp.bfloat16:
+        return False
+    # ResNet trunk channel counts tile the 128-lane registers cleanly
+    if n_in % 64 or n_out % 64:
+        return False
+    return _conv_vmem_ok(k, x_shape, n_in, n_out, jnp.dtype(dtype).itemsize)
+
+
+def bn_supported(*, x, training, **_):
+    """Probe for the "batch_norm" slot: only engages when the input IS a
+    stashed conv-epilogue output (identity match) — otherwise the built-in
+    fused XLA path is already optimal (it needs the stats reduction
+    anyway)."""
+    if not training or not hasattr(x, "ndim") or x.ndim != 4:
+        return False
+    if _INTERPRET:
+        return peek_stats(x)
+    if jax.default_backend() != "tpu" or x.dtype != jnp.bfloat16:
+        return False
+    return peek_stats(x)
+
+
+def _conv2d_helper(x, w, *, strides):
+    y, s1, s2 = conv2d_bn_stats(x, w, tuple(int(s) for s in strides))
+    # stop_gradient: the stats must never carry their own cotangent —
+    # bn_apply's backward already accounts for them (module docstring)
+    _stash_stats(y, lax.stop_gradient(s1), lax.stop_gradient(s2))
+    return y
+
+
+def _bn_helper(x, gamma, beta, eps):
+    st = take_stats(x)
+    if st is None:  # probe checked peek_stats; defensive
+        raise RuntimeError("bn helper called without stashed conv stats")
+    s1, s2 = st
+    n = x.size // x.shape[-1]
+    y, mean, var = bn_apply(x, s1, s2, gamma, beta, float(eps), n, False)
+    # deferred ReLU: a downstream relu ActivationLayer swaps in the fused
+    # variant; the plain-normalize call above then has no consumers and is
+    # dead-code-eliminated at lowering
+    _stash_relu(y, lambda: bn_apply(x, s1, s2, gamma, beta,
+                                    float(eps), n, True)[0])
+    return y, mean, var
+
+
+def register():
+    from deeplearning4j_tpu.ops.helpers import register_helper
+
+    register_helper("conv2d", _conv2d_helper, conv_supported,
+                    name="pallas_conv_bn_stats")
+    register_helper("batch_norm", _bn_helper, bn_supported,
+                    name="pallas_fused_bn_apply")
+
+
+register()

@@ -24,6 +24,18 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def enable_x64():
+    """f64 context manager across jax versions: `jax.enable_x64` was
+    removed in favor of `jax.experimental.enable_x64` in the jax this
+    image ships — the check is useless without it (f64 is the whole
+    point, see module docstring)."""
+    if hasattr(jax, "enable_x64"):
+        return jax.enable_x64()
+    from jax.experimental import enable_x64 as _e64
+
+    return _e64()
+
+
 def numeric_gradient(f: Callable, flat: np.ndarray, epsilon: float = 1e-6,
                      indices=None, chunk: int = 128) -> np.ndarray:
     """Central differences: (f(x+eps e_i) - f(x-eps e_i)) / (2 eps).
@@ -65,7 +77,7 @@ def check_gradients_fn(
     criterion: relative error (|a-n| / (|a|+|n|)) <= max_rel_error, with an
     absolute-error floor for near-zero gradients
     (GradientCheckUtil.java:161-180)."""
-    with jax.enable_x64():
+    with enable_x64():
         flat64 = jnp.asarray(np.asarray(flat_params, dtype=np.float64))
         analytic = np.asarray(jax.grad(lambda p: loss_of_flat(p))(flat64))
 
@@ -136,7 +148,7 @@ def check_gradients_graph(net, xs, ys, features_masks=None, labels_masks=None,
         param_dtype=jnp.float64, compute_dtype=jnp.float64, output_dtype=jnp.float64
     )
     try:
-        with jax.enable_x64():
+        with enable_x64():
             confs = net._ordered_layer_confs()
             params64 = jax.tree_util.tree_map(
                 lambda a: jnp.asarray(np.asarray(a, dtype=np.float64)),
@@ -175,7 +187,7 @@ def _check_gradients_x64(net, x, y, features_mask, labels_mask, epsilon,
                          max_rel_error, min_abs_error, max_checks, verbose):
     from deeplearning4j_tpu.nn.params import flat_to_params
 
-    with jax.enable_x64():
+    with enable_x64():
         params64 = jax.tree_util.tree_map(
             lambda a: jnp.asarray(np.asarray(a, dtype=np.float64)), net.params_list
         )

@@ -13,8 +13,10 @@ from __future__ import annotations
 
 import contextlib
 import glob
+import json
 import logging
 import os
+import re
 from collections import Counter
 from typing import Dict, List, Optional, Tuple
 
@@ -75,6 +77,53 @@ def format_summary(rows: List[Tuple[str, float]]) -> str:
     for name, sec in rows:
         lines.append(f"  {sec * 1e3:9.3f} ms  {name[:110]}")
     return "\n".join(lines)
+
+
+# -- op-family aggregation (the PROFILE_*.md tables, mechanized) -------------
+
+_FAMILY_STRIP = re.compile(r"(\.\d+)+$")
+
+
+def op_family(name: str) -> str:
+    """Collapse an XLA op instance name to its family: drop the HLO
+    parameter list and trailing instance counters, so "fusion.123" /
+    "%convert_reduce_fusion.5" both aggregate with their siblings — the
+    exact grouping used by hand for the PROFILE_*.md tables."""
+    base = name.split("(")[0].strip()
+    base = base.lstrip("%")
+    return _FAMILY_STRIP.sub("", base) or name
+
+
+def family_summary(rows: List[Tuple[str, float]]) -> List[Tuple[str, float]]:
+    """Aggregate [(op_name, seconds)] into [(family, seconds)] desc."""
+    fam: Counter = Counter()
+    for name, sec in rows:
+        fam[op_family(name)] += sec
+    return fam.most_common()
+
+
+def write_profile_json(log_dir: str, path: str, top_ops: int = 40,
+                       meta: Optional[dict] = None) -> dict:
+    """Export the op-family aggregation of the newest trace in log_dir as
+    a JSON artifact, so bench runs attach device-time breakdowns
+    mechanically instead of by hand. Returns the payload (families empty
+    when no xplane/proto is available — same degradation as op_summary)."""
+    rows = op_summary(log_dir, top=1_000_000)
+    fams = family_summary(rows)
+    payload = {
+        "meta": meta or {},
+        "log_dir": os.path.abspath(log_dir),
+        "total_device_sec": round(sum(s for _, s in rows), 6),
+        "families_ms": {name: round(sec * 1e3, 3) for name, sec in fams},
+        "top_ops_ms": [
+            {"op": name, "ms": round(sec * 1e3, 3)}
+            for name, sec in rows[:top_ops]
+        ],
+    }
+    with open(path, "w") as f:
+        json.dump(payload, f, indent=2)
+    logger.info("profile JSON written to %s (%d families)", path, len(fams))
+    return payload
 
 
 class ProfilerListener(IterationListener):

@@ -1,13 +1,45 @@
 #!/usr/bin/env bash
 # Tier-1 verify — the exact command from ROADMAP.md, wrapped so builders
 # and CI invoke ONE entrypoint instead of each re-typing (and drifting
-# from) the canonical flags. Prints DOTS_PASSED=<n> after the run; exits
-# with pytest's status. Slow-marked tests (serving load, multi-process)
-# are excluded — that is what keeps tier-1 fast.
+# from) the canonical flags. Prints DOTS_PASSED=<n> after the run.
+#
+# Gate semantics: the exit status reports REGRESSIONS, not raw failures.
+# The growth seed ships 35 pre-existing failures; a raw count (or
+# pytest's exit code) cannot distinguish new breakage from inherited
+# breakage. So the failing-test NAMES are recorded to an artifact
+# ($T1_FAILURES_ARTIFACT, default /tmp/_t1_failures.txt) and diffed
+# against the committed baseline tests/tier1_baseline_failures.txt:
+#   exit 0  — no failing test that is not already in the baseline
+#   exit 1  — new failures (they are listed)
+#   exit >1 — pytest itself died (timeout, internal error, interrupt)
+# Slow-marked tests (serving load, multi-process) are excluded — that is
+# what keeps tier-1 fast.
 set -o pipefail
 cd "$(dirname "$0")/.."
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
 rc=${PIPESTATUS[0]}
 echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
-exit $rc
+
+artifact="${T1_FAILURES_ARTIFACT:-/tmp/_t1_failures.txt}"
+baseline="tests/tier1_baseline_failures.txt"
+# FAILED lines carry "<id> - <reason>"; ERROR lines (collection errors)
+# carry the file — both are regressions when not in the baseline. Strip
+# the reason suffix rather than taking field 2: parametrized ids may
+# contain spaces and a truncated id could mask a sibling-param regression.
+grep -aE '^(FAILED|ERROR) ' /tmp/_t1.log \
+    | sed -e 's/^FAILED //' -e 's/^ERROR //' -e 's/ - .*$//' \
+    | sort -u > "$artifact"
+
+if [ "$rc" -gt 1 ]; then
+    echo "T1: pytest exited rc=$rc (timeout/internal error) — not gating on names"
+    exit "$rc"
+fi
+new_failures=$(comm -13 <(sort -u "$baseline") "$artifact")
+if [ -n "$new_failures" ]; then
+    echo "T1 REGRESSIONS — failing tests not in $baseline:"
+    echo "$new_failures"
+    exit 1
+fi
+echo "T1 OK: $(wc -l < "$artifact" | tr -d ' ') failing (all within the $(wc -l < "$baseline" | tr -d ' ')-name baseline); artifact: $artifact"
+exit 0

@@ -105,3 +105,58 @@ def test_profiler_listener_collects_summary(tmp_path):
     from deeplearning4j_tpu.utils.profiler import latest_xplane
 
     assert latest_xplane(str(tmp_path / "prof")) is not None
+
+
+def test_op_family_aggregation():
+    """op_family collapses HLO instance names into the PROFILE_*.md
+    grouping; family_summary aggregates times across instances."""
+    from deeplearning4j_tpu.utils.profiler import family_summary, op_family
+
+    assert op_family("fusion.123") == "fusion"
+    assert op_family("%convert_reduce_fusion.5") == "convert_reduce_fusion"
+    assert op_family("add_add_fusion") == "add_add_fusion"
+    assert op_family("copy-done.7") == "copy-done"
+    assert op_family("custom-call.3.1") == "custom-call"
+    assert op_family("fusion.2 (param0)") == "fusion"
+    rows = [("fusion.1", 0.5), ("fusion.2", 0.25),
+            ("convert_reduce_fusion.9", 1.0), ("copy-done", 0.1)]
+    fam = dict(family_summary(rows))
+    assert fam == {"fusion": 0.75, "convert_reduce_fusion": 1.0,
+                   "copy-done": 0.1}
+
+
+def test_write_profile_json(tmp_path, monkeypatch):
+    """profile --json artifact: op-family breakdown serialized for bench
+    runs to attach mechanically."""
+    import json
+
+    from deeplearning4j_tpu.utils import profiler
+
+    rows = [("convert_reduce_fusion.1", 0.010), ("fusion.4", 0.002),
+            ("convert_reduce_fusion.2", 0.005)]
+    monkeypatch.setattr(profiler, "op_summary", lambda d, top=20, **k: rows)
+    out = str(tmp_path / "profile.json")
+    payload = profiler.write_profile_json(str(tmp_path), out,
+                                          meta={"workload": "resnet50"})
+    on_disk = json.load(open(out))
+    assert on_disk == json.loads(json.dumps(payload))
+    assert on_disk["families_ms"]["convert_reduce_fusion"] == 15.0
+    assert on_disk["families_ms"]["fusion"] == 2.0
+    assert on_disk["meta"]["workload"] == "resnet50"
+    assert on_disk["top_ops_ms"][0]["op"] == "convert_reduce_fusion.1"
+
+
+def test_cli_profile_json(tmp_path, monkeypatch, capsys):
+    """`deeplearning4j_tpu profile --log-dir D --json P` writes the
+    artifact through the CLI."""
+    import json
+
+    from deeplearning4j_tpu import cli
+    from deeplearning4j_tpu.utils import profiler
+
+    rows = [("fusion.1", 0.001)]
+    monkeypatch.setattr(profiler, "op_summary", lambda d, top=20, **k: rows)
+    out = str(tmp_path / "p.json")
+    rc = cli.main(["profile", "--log-dir", str(tmp_path), "--json", out])
+    assert rc == 0
+    assert json.load(open(out))["families_ms"] == {"fusion": 1.0}

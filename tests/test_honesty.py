@@ -35,3 +35,65 @@ def test_cited_test_files_exist():
                 missing.append(f"{os.path.relpath(path, REPO)} cites "
                                f"{m.group(0)}")
     assert not missing, "dangling test citations:\n" + "\n".join(missing)
+
+
+def test_bench_vs_baseline_self_reports_trajectory():
+    """bench.py's vs_baseline must come from the newest committed
+    BENCH_r*.json (per-workload speedup ratios), not a hardcoded null —
+    the perf trajectory is self-reporting."""
+    import sys
+
+    sys.path.insert(0, REPO)
+    try:
+        import bench
+    finally:
+        sys.path.pop(0)
+
+    name, prior = bench._prior_bench()
+    if name is None:  # fresh clone without committed bench rounds
+        assert bench._vs_baseline({"resnet50": {"value": 1.0}}, "cpu") is None
+        return
+    assert prior["workloads"]
+    wl, entry = next(iter(prior["workloads"].items()))
+    doubled = {wl: {"value": entry["value"] * 2}}
+    vs = bench._vs_baseline(doubled, prior.get("backend"))
+    assert vs["source"] == name
+    assert abs(vs["speedup"][wl] - 2.0) < 1e-6
+    # cross-backend ratios would be nonsense — omitted, with the reason
+    mism = bench._vs_baseline(doubled, "not-" + str(prior.get("backend")))
+    assert "speedup" not in mism and "mismatch" in mism["note"]
+
+
+def test_bench_ab_refuses_mid_run_disabled_kernel():
+    """_run_ab must not report a variant under the kernel's name when the
+    SPI auto-disabled the helper mid-run (fn raised, layers fell back):
+    that number is builtin throughput. Kill-switch state is restored."""
+    import sys
+
+    sys.path.insert(0, REPO)
+    try:
+        import bench
+    finally:
+        sys.path.pop(0)
+    from deeplearning4j_tpu.ops.helpers import (
+        _HELPERS,
+        helper_enabled,
+        register_helper,
+        set_helper_enabled,
+    )
+
+    register_helper("_ab_test", lambda: None, name="scratch")
+    try:
+        def run(on):
+            if on:  # simulate the SPI guard disabling a raising helper
+                set_helper_enabled("_ab_test", False)
+            return 1.0
+
+        results, errors = bench._run_ab(
+            run, [("kern", True), ("builtin", False)], ("_ab_test",))
+        assert "kern" not in results
+        assert "disabled mid-run" in errors["kern"]
+        assert results["builtin"] == 1.0
+        assert helper_enabled("_ab_test") is True  # restored
+    finally:
+        _HELPERS.pop("_ab_test", None)
