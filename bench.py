@@ -652,9 +652,18 @@ def _probe():
 
 
 def _workload(name):
-    """Child mode: run one workload, print its JSON dict."""
+    """Child mode: run one workload, print its JSON dict. The shared
+    metrics-registry snapshot rides along so compile counts, helper
+    hit/fallback/auto-disable events, and step-phase histograms land in
+    the committed BENCH_r*.json next to the perf numbers they explain."""
     out = WORKLOADS[name]()
     out["backend"] = jax.default_backend()
+    try:
+        from deeplearning4j_tpu.utils.metrics import get_registry
+
+        out["metrics_registry"] = get_registry().snapshot()
+    except Exception as e:  # a metrics bug must never sink a bench run
+        out["metrics_registry"] = {"error": f"{type(e).__name__}: {e}"}
     print(json.dumps(out))
 
 

@@ -24,4 +24,57 @@ TPU-first throughout.
 
 __version__ = "0.1.0"
 
+import logging as _logging
+
+# Library-logging contract: the framework logs to the
+# "deeplearning4j_tpu" logger everywhere, and library code must not
+# print or configure handlers on its own — the NullHandler silences the
+# "No handlers could be found" fallback until the APP opts in (below, or
+# with its own logging config).
+_logging.getLogger("deeplearning4j_tpu").addHandler(_logging.NullHandler())
+
+
+def configure_logging(level=_logging.INFO, json_lines: bool = False,
+                      stream=None):
+    """Opt-in log output for applications and CLIs.
+
+    Plain mode attaches a conventional stderr handler. `json_lines=True`
+    emits one JSON object per record (ts/level/logger/message) so log
+    aggregators get structured records without a parsing layer. Calling
+    again replaces the handler installed by the previous call (idempotent
+    — safe from notebooks/REPLs)."""
+    import json as _json
+    import time as _time
+
+    logger = _logging.getLogger("deeplearning4j_tpu")
+    for h in list(logger.handlers):
+        if getattr(h, "_dl4j_tpu_configured", False):
+            logger.removeHandler(h)
+    handler = _logging.StreamHandler(stream)
+    if json_lines:
+        class _JsonFormatter(_logging.Formatter):
+            def format(self, record):
+                doc = {
+                    "ts": round(record.created, 3),
+                    "iso": _time.strftime(
+                        "%Y-%m-%dT%H:%M:%S",
+                        _time.gmtime(record.created)) + "Z",
+                    "level": record.levelname,
+                    "logger": record.name,
+                    "message": record.getMessage(),
+                }
+                if record.exc_info:
+                    doc["exc"] = self.formatException(record.exc_info)
+                return _json.dumps(doc)
+
+        handler.setFormatter(_JsonFormatter())
+    else:
+        handler.setFormatter(_logging.Formatter(
+            "%(asctime)s %(levelname)s %(name)s: %(message)s"))
+    handler._dl4j_tpu_configured = True
+    logger.addHandler(handler)
+    logger.setLevel(level)
+    return logger
+
+
 from deeplearning4j_tpu.common.dtypes import PrecisionPolicy, default_policy

@@ -257,6 +257,36 @@ def cmd_profile(args) -> int:
     return 0
 
 
+def cmd_metrics(args) -> int:
+    """Metrics snapshot -> stdout or a JSON file. With --url, scrape a
+    running server (inference-server /metrics; any endpoint speaking the
+    same routes); without it, dump THIS process's registry — useful from
+    scripts that embed training/serving in-process (bench.py does the
+    same thing per workload)."""
+    import json as _json
+    import urllib.request
+
+    if args.url:
+        url = args.url.rstrip("/") + "/metrics"
+        if args.format == "prometheus":
+            url += "?format=prometheus"
+        with urllib.request.urlopen(url, timeout=args.timeout) as r:
+            text = r.read().decode()
+    else:
+        from deeplearning4j_tpu.utils.metrics import get_registry
+
+        reg = get_registry()
+        text = (reg.to_prometheus() if args.format == "prometheus"
+                else _json.dumps(reg.snapshot(), indent=2))
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(text if text.endswith("\n") else text + "\n")
+        print(f"wrote {args.output}")
+    else:
+        print(text)
+    return 0
+
+
 def main(argv=None) -> int:
     # honor JAX_PLATFORMS even when a sitecustomize imported jax before
     # this process's env was consulted (config update beats env once the
@@ -336,6 +366,21 @@ def main(argv=None) -> int:
                    help="write the aggregation to this path as JSON")
     p.add_argument("--top", type=int, default=40)
     p.set_defaults(fn=cmd_profile)
+
+    m = sub.add_parser(
+        "metrics",
+        help="metrics snapshot: scrape a server's /metrics or dump this "
+             "process's registry (utils/metrics.py)")
+    m.add_argument("--url", default=None,
+                   help="base URL of a running server, e.g. "
+                        "http://127.0.0.1:9100 (omit to dump the local "
+                        "process registry)")
+    m.add_argument("--format", choices=("json", "prometheus"),
+                   default="json")
+    m.add_argument("--output", default=None,
+                   help="write to this file instead of stdout")
+    m.add_argument("--timeout", type=float, default=10.0)
+    m.set_defaults(fn=cmd_metrics)
 
     args = ap.parse_args(argv)
     return args.fn(args)

@@ -395,6 +395,7 @@ class ComputationGraph(NetworkBase):
     def _fit_step(self, xs, ys, f_masks, l_masks, stateful_states=None):
         if self._train_step_fn is None:
             self._train_step_fn = self._build_train_step()
+            self._note_compile("train_step")
         lr = schedule_lr(self.net_conf, self.iteration)
         rng = jax.random.fold_in(
             jax.random.PRNGKey(self.net_conf.seed ^ 0x5EED), self.iteration
@@ -723,6 +724,7 @@ class ComputationGraph(NetworkBase):
             backend = jax.default_backend()
             donate = (0, 2) if backend != "cpu" else ()
             self._trunc_step_fn = jax.jit(body, donate_argnums=donate)
+            self._note_compile("train_step_truncated")
 
         lr = schedule_lr(self.net_conf, self.iteration)
         rng = jax.random.fold_in(

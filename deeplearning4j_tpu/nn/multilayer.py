@@ -496,6 +496,7 @@ class MultiLayerNetwork(NetworkBase):
         """One optimizer step. Returns the (device) score."""
         if self._train_step_fn is None:
             self._train_step_fn = self._build_train_step()
+            self._note_compile("train_step")
         return self._run_step(
             self._train_step_fn, (x, y, f_mask, l_mask), stateful_states
         )
@@ -505,6 +506,7 @@ class MultiLayerNetwork(NetworkBase):
         between slice A (state-carry, stop-gradient) and slice B."""
         if getattr(self, "_trunc_step_fn", None) is None:
             self._trunc_step_fn = self._build_truncated_bwd_step()
+            self._note_compile("train_step_truncated")
         return self._run_step(
             self._trunc_step_fn, dataA + dataB, stateful_states
         )

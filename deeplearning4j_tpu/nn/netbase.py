@@ -24,6 +24,8 @@ from deeplearning4j_tpu.nn.params import (
     param_table,
     params_to_flat,
 )
+from deeplearning4j_tpu.utils import metrics as _metrics
+from deeplearning4j_tpu.utils import tracing as _tracing
 
 
 class NetworkBase:
@@ -57,6 +59,10 @@ class NetworkBase:
         # (ParallelInference calls output() from several threads)
         self._output_compiles = 0
         self._output_cache_lock = threading.Lock()
+        # shared-registry fit instruments, resolved ONCE on first use so
+        # the per-step hot path touches cached children only (the ISSUE's
+        # overhead guard: zero registry lookups per step)
+        self._fit_instruments = None
 
     # -- to be provided by subclasses ----------------------------------------
 
@@ -95,7 +101,20 @@ class NetworkBase:
             if fn is None:
                 fn = self._output_fn[key] = make_fn()
                 self._output_compiles += 1
+                self._note_compile("output", key)
             return fn
+
+    def _note_compile(self, kind: str, key=None):
+        """Record a jit-cache insertion (a fresh trace/compile) as a
+        first-class event: `compile_total{kind}` in the shared registry
+        plus a trace instant carrying the shape signature — compile
+        storms become a scrape-able number with the shapes that caused
+        them, instead of mystery tail latency."""
+        _metrics.get_registry().counter(
+            "compile_total", "jit cache insertions (fresh traces)",
+            ("kind",)).labels(kind).inc()
+        _tracing.instant("compile", kind=kind,
+                         key=None if key is None else str(key))
 
     # -- listeners -----------------------------------------------------------
 
@@ -186,6 +205,71 @@ class NetworkBase:
         for lst in self.listeners:
             lst.iteration_done(self, self.iteration - 1, info)
 
+    # -- fit-loop observability ----------------------------------------------
+
+    def _fit_obs(self):
+        """Fit-loop instruments from the shared registry, resolved ONCE
+        per network and cached — the per-step hot path touches these
+        children only, never the registry (the ISSUE's overhead guard)."""
+        ins = self._fit_instruments
+        if ins is None:
+            reg = _metrics.get_registry()
+            ins = self._fit_instruments = {
+                "steps": reg.counter(
+                    "fit_step_total", "optimizer steps run").labels(),
+                "examples": reg.counter(
+                    "fit_examples_total",
+                    "training examples consumed").labels(),
+                "data_wait": reg.histogram(
+                    "fit_data_wait_seconds",
+                    "time blocked on the data iterator (ETL) before a "
+                    "dispatch").labels(),
+                "dispatch": reg.histogram(
+                    "fit_dispatch_seconds",
+                    "host time in the train-step call (trace + dispatch; "
+                    "excludes device sync)").labels(),
+                "sync": reg.histogram(
+                    "fit_device_sync_seconds",
+                    "device sync to the step's score — measured only "
+                    "while tracing is enabled, so the default fit path "
+                    "never adds blocking syncs").labels(),
+            }
+        return ins
+
+    def _timed_fit(self, fit_fn, data_wait: float, n_examples: int):
+        """Run one dispatch (a single `_fit_dataset` or a fused flush)
+        under the step-phase timers: data-wait / dispatch / device-sync,
+        each a histogram in the shared registry and a span when tracing
+        is on. Device-sync is only MEASURED (a blocking read of the
+        step's score) when tracing is enabled — observability must not
+        change the async dispatch pipeline it observes."""
+        ins = self._fit_obs()
+        it0 = self.iteration
+        t0 = time.perf_counter()
+        with _tracing.span("fit/step", data_wait_ms=round(data_wait * 1e3, 3)):
+            with _tracing.span("fit/dispatch"):
+                fit_fn()
+            dispatch = time.perf_counter() - t0
+            if _tracing.is_enabled() and self._score is not None:
+                import jax
+
+                t1 = time.perf_counter()
+                with _tracing.span("fit/device_sync"):
+                    jax.block_until_ready(self._score)
+                ins["sync"].observe(time.perf_counter() - t1)
+        ins["steps"].inc(max(1, self.iteration - it0))
+        ins["examples"].inc(n_examples)
+        ins["data_wait"].observe(data_wait)
+        ins["dispatch"].observe(dispatch)
+
+    @staticmethod
+    def _ds_examples(ds) -> int:
+        try:
+            return int(getattr(ds, "reported_examples", None)
+                       or ds.num_examples())
+        except Exception:
+            return 0
+
     # -- the fit loop --------------------------------------------------------
 
     def _run_fit(self, iterator, epochs: int, async_prefetch: bool,
@@ -199,35 +283,71 @@ class NetworkBase:
             and self._batch_transform is None
             and self._fused_fit_supported()
         ) else 1
+        try:
+            self._fit_epochs(iterator, epochs, fuse_k)
+        finally:
+            # fires even when an epoch raises: listeners that flipped
+            # process-global state for the run (TracingListener) restore
+            # it here instead of leaking it past a failed fit
+            for lst in self.listeners:
+                hook = getattr(lst, "on_fit_end", None)
+                if hook is not None:
+                    hook(self)
+        return self
+
+    def _fit_epochs(self, iterator, epochs: int, fuse_k: int):
         for _ in range(epochs):
             for lst in self.listeners:
                 lst.on_epoch_start(self, self.epoch)
             t_etl = time.perf_counter()
             buf, sig = [], None
+            # data-wait accumulates across buffered (fused) batches so a
+            # fused dispatch's histogram entry covers ALL the iterator
+            # blocking it amortizes, not just the last batch's
+            wait_accum = 0.0
+            n_buf = 0
             for ds in iterator:
-                self._last_etl_ms = (time.perf_counter() - t_etl) * 1e3
+                wait = time.perf_counter() - t_etl
+                self._last_etl_ms = wait * 1e3
                 if self._batch_transform is not None:
                     ds = self._batch_transform(ds)
                 if fuse_k > 1:
                     s = self._ds_signature(ds)
                     if buf and s != sig:
-                        self._flush_fused(buf, fuse_k)
+                        # flush BEFORE charging this batch's wait: it
+                        # belongs to the group this batch starts, not the
+                        # one it closes
+                        flushed, n = list(buf), n_buf
+                        self._timed_fit(
+                            lambda: self._flush_fused(flushed, fuse_k),
+                            wait_accum, n)
+                        wait_accum, n_buf = 0.0, 0
                         buf = []
+                    wait_accum += wait
                     sig = s
                     buf.append(ds)
+                    n_buf += self._ds_examples(ds)
                     if len(buf) == fuse_k:
-                        self._flush_fused(buf, fuse_k)
+                        flushed, n = list(buf), n_buf
+                        self._timed_fit(
+                            lambda: self._flush_fused(flushed, fuse_k),
+                            wait_accum, n)
+                        wait_accum, n_buf = 0.0, 0
                         buf = []
                 else:
-                    self._fit_dataset(ds)
+                    wait_accum += wait
+                    self._timed_fit(lambda: self._fit_dataset(ds),
+                                    wait_accum, self._ds_examples(ds))
+                    wait_accum = 0.0
                 t_etl = time.perf_counter()
             if buf:
-                self._flush_fused(buf, fuse_k)
+                flushed, n = list(buf), n_buf
+                self._timed_fit(lambda: self._flush_fused(flushed, fuse_k),
+                                wait_accum, n)
             for lst in self.listeners:
                 lst.on_epoch_end(self, self.epoch)
             self.epoch += 1
             iterator.reset()
-        return self
 
     def _flush_fused(self, buf, fuse_k):
         """Full chunks run fused; ragged tails fall back to per-step fits

@@ -16,7 +16,26 @@ import dataclasses
 import logging
 from typing import Callable, Dict, Optional
 
+from deeplearning4j_tpu.utils import metrics as _metrics
+from deeplearning4j_tpu.utils import tracing as _tracing
+
 logger = logging.getLogger("deeplearning4j_tpu")
+
+
+def _count(metric: str, op: str, helper: str, reason: Optional[str] = None):
+    """Helper SPI events in the shared registry: selection hits,
+    builtin-path fallbacks (with why), and auto-disables. These happen at
+    trace time, not per device step, so a registry lookup per event is
+    fine — and it makes PR 2's "helper silently auto-disabled mid-run"
+    kill switch a scrape-able series instead of a bench-only check."""
+    reg = _metrics.get_registry()
+    if reason is None:
+        reg.counter(metric, "Helper SPI events",
+                    ("op", "helper")).labels(op, helper).inc()
+    else:
+        reg.counter(metric, "Helper SPI events",
+                    ("op", "helper", "reason")).labels(op, helper,
+                                                       reason).inc()
 
 
 class HelperError(RuntimeError):
@@ -60,14 +79,20 @@ def get_helper(op: str, **ctx) -> Optional[Callable]:
     without the guard a broken kernel would kill the layer with no
     fallback even though the probe passed."""
     h = _HELPERS.get(op)
-    if h is None or not h.enabled:
+    if h is None:
+        return None
+    if not h.enabled:
+        _count("helper_fallback_total", op, h.name, "disabled")
         return None
     try:
         if not h.supported(**ctx):
+            _count("helper_fallback_total", op, h.name, "unsupported")
             return None
     except Exception as e:  # a broken probe must never kill the fallback
         logger.warning("helper %s probe failed: %s", h.name, e)
+        _count("helper_fallback_total", op, h.name, "probe_error")
         return None
+    _count("helper_hit_total", op, h.name)
 
     def guarded(*args, **kwargs):
         try:
@@ -78,6 +103,10 @@ def get_helper(op: str, **ctx) -> Optional[Callable]:
                 "helper %s (op %s) raised %s: %s — helper disabled, "
                 "falling back to the built-in path", h.name, op,
                 type(e).__name__, e)
+            _count("helper_auto_disable_total", op, h.name)
+            _count("helper_fallback_total", op, h.name, "raised")
+            _tracing.instant("helper/auto_disable", op=op, helper=h.name,
+                             error=f"{type(e).__name__}: {e}")
             raise HelperError(f"helper {h.name} failed: {e}") from e
 
     return guarded

@@ -36,6 +36,8 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
+import weakref
 from concurrent.futures import Future
 from typing import List, Optional, Sequence
 
@@ -49,6 +51,8 @@ from deeplearning4j_tpu.parallel.mesh import (
     pad_wrap,
     replicated,
 )
+from deeplearning4j_tpu.utils import metrics as _metrics
+from deeplearning4j_tpu.utils import tracing as _tracing
 
 
 class InferenceMode:
@@ -60,6 +64,13 @@ class RequestValidationError(ValueError):
     """The REQUEST was malformed (empty, or feature shape mismatching the
     endpoint's) — distinguishes client faults from server-side ValueErrors
     so REST layers can map 400 vs 500 correctly."""
+
+
+def _queue_depth(ref) -> int:
+    pi = ref()
+    if pi is None:
+        return 0
+    return pi._q.qsize() + pi._handoff.qsize()
 
 
 def power_of_two_buckets(max_batch_size: int) -> List[int]:
@@ -121,6 +132,11 @@ class ParallelInference:
         # malformed first request cannot poison the endpoint forever
         self._shape_confirmed = False
         self._shutdown = False
+        # _stats is PER-INSTANCE (the JSON /metrics schema: this
+        # endpoint's traffic); the registry counters below are
+        # process-global aggregates across every ParallelInference in the
+        # process — deriving either from the other would conflate the two
+        # scopes, so both are maintained
         self._stats = {
             "requests": 0,
             "examples": 0,
@@ -128,6 +144,33 @@ class ParallelInference:
             "oversized": 0,
             "bucket_hits": {b: 0 for b in self.buckets},
         }
+        # shared-registry serving instruments (same registry as training's
+        # fit_step_* / compile_total — ONE scrape sees both). Children are
+        # resolved here once; the request path only touches the cached
+        # handles. The queue-depth gauge reads through a weakref so a
+        # shut-down ParallelInference is not kept alive by the registry
+        # (the newest instance owns the gauge).
+        reg = _metrics.get_registry()
+        self._m_requests = reg.counter(
+            "serving_requests_total", "inference requests admitted").labels()
+        self._m_examples = reg.counter(
+            "serving_examples_total", "inference examples admitted").labels()
+        self._m_bucket = reg.counter(
+            "serving_bucket_hits_total",
+            "fused groups served, by landing bucket", ("bucket",))
+        self._m_oversized = reg.counter(
+            "serving_oversized_total",
+            "requests larger than every bucket (ran unfused)").labels()
+        self._m_handoff = reg.histogram(
+            "serving_handoff_stall_seconds",
+            "collector time blocked handing a prepared group to the "
+            "dispatcher (device a full group behind = backpressure)"
+        ).labels()
+        ref = weakref.ref(self)
+        reg.gauge(
+            "serving_queue_depth",
+            "requests + prepared groups waiting for the device"
+        ).set_function(lambda: _queue_depth(ref))
         self._collect_t: Optional[threading.Thread] = None
         self._dispatch_t: Optional[threading.Thread] = None
         if self.mode == InferenceMode.BATCHED:
@@ -169,6 +212,8 @@ class ParallelInference:
                 )
             self._stats["requests"] += 1
             self._stats["examples"] += xx.shape[0]
+            self._m_requests.inc()
+            self._m_examples.inc(xx.shape[0])
             fut: Optional[Future] = None
             if (self.mode == InferenceMode.BATCHED
                     and xx.shape[0] <= self.max_batch_size):
@@ -293,6 +338,10 @@ class ParallelInference:
                 self._stats["oversized"] += 1
             else:
                 self._stats["bucket_hits"][b] += 1
+        if b is None:
+            self._m_oversized.inc()
+        else:
+            self._m_bucket.labels(str(b)).inc()
 
     def _forward_padded(self, padded: np.ndarray, n: int,
                         b: Optional[int], count: bool = True):
@@ -301,8 +350,9 @@ class ParallelInference:
         pad rows sliced off. A multi-output ComputationGraph returns a
         list; the batch slice applies per output, not to the list."""
         try:
-            out = self.model.output(
-                jax.device_put(padded, batch_sharded(self.mesh)))
+            with _tracing.span("serve/forward", bucket=b, rows=n):
+                out = self.model.output(
+                    jax.device_put(padded, batch_sharded(self.mesh)))
             if isinstance(out, (list, tuple)):
                 out = [np.asarray(o)[:n] for o in out]
             else:
@@ -384,9 +434,11 @@ class ParallelInference:
                 if not fut.done():
                     fut.set_exception(e)
             return
+        t0 = time.perf_counter()
         self._handoff.put(
             (padded, n, b, [fut for _, fut in group],
              [g[0].shape[0] for g in group]))
+        self._m_handoff.observe(time.perf_counter() - t0)
 
     # BATCHED pipeline, stage 2: device forward + scatter results
     def _dispatcher(self):

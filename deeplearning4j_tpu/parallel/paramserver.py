@@ -38,11 +38,13 @@ import logging
 import queue
 import struct
 import threading
+import time
 import urllib.request
 from typing import Dict, List, Optional
 
 import numpy as np
 
+from deeplearning4j_tpu.utils import metrics as _metrics
 from deeplearning4j_tpu.utils.jsonhttp import JsonHttpServer, json_response
 
 logger = logging.getLogger("deeplearning4j_tpu")
@@ -109,6 +111,16 @@ class EmbeddingParameterServer:
         self._locks = {k: threading.Lock() for k in self.tables}
         self._server = JsonHttpServer(post=self._post, port=port)
         self.pushes_applied = 0
+        # RPC counters + latency histograms in the shared registry, by
+        # route — the PS hot path (pull.bin/push.bin) becomes a series an
+        # operator can alert on instead of a private attribute
+        reg = _metrics.get_registry()
+        self._m_rpc = reg.counter(
+            "paramserver_rpc_total", "parameter-server RPCs served",
+            ("route",))
+        self._m_rpc_sec = reg.histogram(
+            "paramserver_rpc_seconds", "parameter-server RPC service time",
+            ("route",))
 
     @property
     def port(self) -> int:
@@ -129,6 +141,23 @@ class EmbeddingParameterServer:
     # -- http transport ------------------------------------------------------
 
     def _post(self, path, body, headers):
+        if path in ("/pull.bin", "/push.bin", "/pull", "/push"):
+            route = path.lstrip("/")
+            t0 = time.perf_counter()
+            try:
+                return self._post_timed(path, body)
+            finally:
+                self._m_rpc.labels(route).inc()
+                self._m_rpc_sec.labels(route).observe(
+                    time.perf_counter() - t0)
+        if path == "/meta":
+            return json_response({
+                "tables": {k: list(v.shape) for k, v in self.tables.items()},
+                "pushes_applied": self.pushes_applied,
+            })
+        return None
+
+    def _post_timed(self, path, body):
         if path == "/pull.bin":
             name, rows, _ = _unpack_request(body)
             return 200, "application/octet-stream", _pack_rows(
@@ -137,20 +166,13 @@ class EmbeddingParameterServer:
             name, rows, deltas = _unpack_request(body)
             self.push(name, rows.tolist(), deltas)
             return 200, "application/octet-stream", b"ok"
-        if path == "/meta":
-            return json_response({
-                "tables": {k: list(v.shape) for k, v in self.tables.items()},
-                "pushes_applied": self.pushes_applied,
-            })
         req = json.loads(body)
         name = req["table"]
         rows = req["rows"]
         if path == "/pull":
             return json_response({"data": self.pull(name, rows).tolist()})
-        if path == "/push":
-            self.push(name, rows, np.asarray(req["deltas"], np.float32))
-            return json_response({"status": "ok"})
-        return None
+        self.push(name, rows, np.asarray(req["deltas"], np.float32))
+        return json_response({"status": "ok"})
 
     def start(self) -> int:
         return self._server.start()
@@ -177,6 +199,16 @@ class EmbeddingPSClient:
         self.dropped_pushes = 0
         self._dims: Dict[str, int] = {}
         self._q: "queue.Queue" = queue.Queue(maxsize=queue_size)
+        reg = _metrics.get_registry()
+        self._m_rpc = reg.counter(
+            "paramserver_client_rpc_total",
+            "parameter-server client RPCs issued", ("route",))
+        self._m_rpc_sec = reg.histogram(
+            "paramserver_client_rpc_seconds",
+            "parameter-server client RPC round-trip time", ("route",))
+        self._m_dropped = reg.counter(
+            "paramserver_client_push_dropped_total",
+            "push batches lost to dead/misbehaving endpoints").labels()
         self._worker = threading.Thread(target=self._drain, daemon=True)
         self._worker.start()
 
@@ -187,8 +219,15 @@ class EmbeddingPSClient:
         req = urllib.request.Request(
             f"{url}{route}", data=payload,
             headers={"Content-Type": "application/octet-stream"})
-        with urllib.request.urlopen(req, timeout=self.timeout) as r:
-            return r.read()
+        label = route.lstrip("/")
+        t0 = time.perf_counter()
+        try:  # count failures too (server side does the same): an outage
+            # must show up in the RPC series, not just the drop counter
+            with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                return r.read()
+        finally:
+            self._m_rpc.labels(label).inc()
+            self._m_rpc_sec.labels(label).observe(time.perf_counter() - t0)
 
     def _dim(self, table: str) -> int:
         """Table dim, cached from the first shard's /meta (needed to shape
@@ -250,6 +289,7 @@ class EmbeddingPSClient:
                 # the drain thread alive — a dead thread would silently
                 # wedge push_async once the bounded queue fills
                 self.dropped_pushes += 1
+                self._m_dropped.inc()
                 logger.warning("PS push dropped (%d total): %s",
                                self.dropped_pushes, e)
             finally:

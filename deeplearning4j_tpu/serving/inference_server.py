@@ -18,6 +18,13 @@ device execution). Routes:
                        "buckets", "bucket_hits", "oversized",
                        "forward_compiles", "latency_ms":
                        {"count", "mean_ms", "p50_ms", "p99_ms"}, ...}
+    GET  /metrics?format=prometheus
+                   -> text exposition of the process-global registry
+                      (utils/metrics.py): serving series plus any
+                      training-side fit_step_* / compile_total /
+                      helper_* counters living in the same process
+    GET  /trace    -> recent host spans as JSONL (utils/tracing.py);
+                      ?format=chrome returns a chrome://tracing document
 
 Knobs (constructor and CLI flags): `max_batch_size`, `batch_timeout_ms`,
 `buckets`, `warmup_shape` (precompiles every bucket before the port
@@ -29,6 +36,7 @@ from __future__ import annotations
 import argparse
 import json
 import time
+import urllib.parse
 from typing import Optional, Sequence
 
 import numpy as np
@@ -38,6 +46,8 @@ from deeplearning4j_tpu.parallel.inference import (
     ParallelInference,
     RequestValidationError,
 )
+from deeplearning4j_tpu.utils import metrics as _metrics
+from deeplearning4j_tpu.utils import tracing as _tracing
 from deeplearning4j_tpu.utils.jsonhttp import JsonHttpServer, json_response
 from deeplearning4j_tpu.utils.latency import LatencyTracker
 
@@ -61,6 +71,11 @@ class InferenceServer:
         if warmup_shape is not None:
             self.inference.warmup(warmup_shape)
         self.latency = LatencyTracker()
+        # request latency also lands in the shared registry so one
+        # Prometheus scrape carries serving AND training series
+        self._m_latency = _metrics.get_registry().histogram(
+            "serving_request_seconds",
+            "end-to-end /predict latency (admission to result)").labels()
         self._server = JsonHttpServer(get=self._get, post=self._post,
                                       port=port)
 
@@ -78,15 +93,34 @@ class InferenceServer:
     # -- request handling ----------------------------------------------------
 
     def _get(self, path, body, headers):
-        if path == "/health":
+        parsed = urllib.parse.urlparse(path)
+        route = parsed.path
+        query = urllib.parse.parse_qs(parsed.query)
+        fmt = (query.get("format") or [""])[0]
+        if route == "/health":
             shape = self.inference._expected_shape
             return json_response({
                 "status": "ok",
                 "model": type(self.inference.model).__name__,
                 "feature_shape": None if shape is None else list(shape),
             })
-        if path == "/metrics":
+        if route == "/metrics":
+            if fmt == "prometheus":
+                text = _metrics.get_registry().to_prometheus()
+                return 200, "text/plain; version=0.0.4", text.encode()
             return json_response(self.metrics())
+        if route == "/trace":
+            # recent host spans — JSONL by default (tail-able), or the
+            # chrome://tracing document with ?format=chrome
+            tracer = _tracing.get_tracer()
+            if fmt == "chrome":
+                return json_response(tracer.to_chrome_trace())
+            n_raw = (query.get("n") or [None])[0]
+            try:
+                n = None if n_raw is None else max(0, int(n_raw))
+            except ValueError:
+                n = None
+            return 200, "application/x-ndjson", tracer.to_jsonl(n).encode()
         return None
 
     def _post(self, path, body, headers):
@@ -107,7 +141,8 @@ class InferenceServer:
             feats = feats[None]
         t0 = time.perf_counter()
         try:
-            out = self.inference.output(feats)
+            with _tracing.span("serve/predict", examples=int(feats.shape[0])):
+                out = self.inference.output(feats)
         except RequestValidationError as e:  # the client's fault
             return json_response({"error": str(e)}, 400)
         except Exception as e:
@@ -116,7 +151,9 @@ class InferenceServer:
             # clients/load-balancers retry or fail over (JsonHttpServer's
             # catch-all would mislabel it a 400)
             return json_response({"error": f"{type(e).__name__}: {e}"}, 500)
-        self.latency.record(time.perf_counter() - t0)
+        dt = time.perf_counter() - t0
+        self.latency.record(dt)
+        self._m_latency.observe(dt)
         if isinstance(out, list):  # multi-output graph: one entry per head
             preds = [np.asarray(o)[0].tolist() if single
                      else np.asarray(o).tolist() for o in out]

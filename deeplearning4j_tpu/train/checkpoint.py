@@ -26,6 +26,8 @@ import time
 from typing import Optional, Tuple
 
 from deeplearning4j_tpu.train.listeners import IterationListener
+from deeplearning4j_tpu.utils import metrics as _metrics
+from deeplearning4j_tpu.utils import tracing as _tracing
 
 logger = logging.getLogger("deeplearning4j_tpu")
 
@@ -91,12 +93,20 @@ class CheckpointListener(IterationListener):
             logger.warning("checkpoint save already in flight; skipping "
                            "(%s)", reason)
             return None
+        t0 = time.perf_counter()
         try:
             name = f"checkpoint_iter{model.iteration:09d}.zip"
             path = os.path.join(self.dir, name)
             tmp = f"{path}.{os.getpid()}.{reason}.tmp"  # unique per writer
-            save_model(model, tmp, save_updater=self.save_updater)
-            os.replace(tmp, path)  # atomic: never a torn checkpoint
+            with _tracing.span("checkpoint/save", reason=reason):
+                save_model(model, tmp, save_updater=self.save_updater)
+                os.replace(tmp, path)  # atomic: never a torn checkpoint
+            reg = _metrics.get_registry()
+            reg.counter("checkpoint_saves_total", "checkpoints written",
+                        ("reason",)).labels(reason).inc()
+            reg.histogram("checkpoint_save_seconds",
+                          "checkpoint save duration (serialize + atomic "
+                          "rename)").observe(time.perf_counter() - t0)
             meta = {
                 "iteration": int(model.iteration),
                 "epoch": int(model.epoch),
@@ -189,6 +199,11 @@ class CheckpointListener(IterationListener):
             raise FileNotFoundError(f"no checkpoint in {directory!r}")
         with open(meta_path) as f:
             meta = json.load(f)
-        model = load_model(os.path.join(directory, meta["file"]),
-                           load_updater=load_updater)
+        t0 = time.perf_counter()
+        with _tracing.span("checkpoint/load", file=meta.get("file")):
+            model = load_model(os.path.join(directory, meta["file"]),
+                               load_updater=load_updater)
+        _metrics.get_registry().histogram(
+            "checkpoint_load_seconds",
+            "checkpoint restore duration").observe(time.perf_counter() - t0)
         return model, meta

@@ -29,6 +29,12 @@ class IterationListener:
     def on_epoch_end(self, model, epoch: int) -> None:
         pass
 
+    def on_fit_end(self, model) -> None:
+        """Called when the fit loop exits — INCLUDING on an exception
+        (netbase runs it in a finally). The hook for restoring any
+        process-global state a listener flipped for the run."""
+        pass
+
 
 class ScoreIterationListener(IterationListener):
     """Log the score every `frequency` iterations (reference:
@@ -54,11 +60,17 @@ class PerformanceListener(IterationListener):
         self._last_time = None
         self._samples = 0
         self._iters = 0
+        self._etl_ms = 0.0
 
     def iteration_done(self, model, iteration, info):
         now = time.perf_counter()
         self._samples += info.get("batch_size", 0)
         self._iters += 1
+        # accumulate the fit loop's per-batch data-wait measurement so the
+        # printed ETL is the window's average, not whatever the last batch
+        # happened to block for (reference: PerformanceListener.java
+        # reports real ETL time per window)
+        self._etl_ms += info.get("etl_ms", 0.0)
         if self._last_time is None:
             self._last_time = now
             return
@@ -68,11 +80,12 @@ class PerformanceListener(IterationListener):
                 self.print_fn(
                     f"iter {iteration}: {self._iters / dt:.1f} it/s, "
                     f"{self._samples / dt:.1f} samples/s, "
-                    f"etl {info.get('etl_ms', 0.0):.1f} ms"
+                    f"etl {self._etl_ms / self._iters:.1f} ms/iter"
                 )
             self._last_time = now
             self._samples = 0
             self._iters = 0
+            self._etl_ms = 0.0
 
 
 class CollectScoresIterationListener(IterationListener):
@@ -103,6 +116,68 @@ class EvaluativeListener(IterationListener):
             ev = model.evaluate(self.iterator)
             self.last_evaluation = ev
             self.print_fn(f"iter {iteration}: accuracy={ev.accuracy():.4f}")
+
+
+class TracingListener(IterationListener):
+    """Turn on the host-side span tracer for a training run and export
+    the buffer at epoch ends — training jobs get the same span
+    visibility as serving (`InferenceServer GET /trace`), through the
+    listener SPI instead of an HTTP route.
+
+    With tracing enabled, the fit loop itself emits the `fit/step` /
+    `fit/dispatch` / `fit/device_sync` spans (nn/netbase.py); this
+    listener adds an `iteration` instant per step (iteration number +
+    batch size) and writes `jsonl_path` / `chrome_path` after each epoch
+    so a killed run still leaves a trace artifact behind.
+
+    Tracing is enabled at each epoch start and restored to its prior
+    state at each epoch end (pass restore_on_epoch_end=False to leave it
+    on between/after epochs). Construction alone changes nothing — the
+    tracing flag is process-global and flipping it permanently would
+    impose the per-step device sync on every OTHER net in the process."""
+
+    def __init__(self, jsonl_path: Optional[str] = None,
+                 chrome_path: Optional[str] = None,
+                 restore_on_epoch_end: bool = True):
+        from deeplearning4j_tpu.utils import tracing
+
+        self._tracing = tracing
+        self.jsonl_path = jsonl_path
+        self.chrome_path = chrome_path
+        self._restore = restore_on_epoch_end
+        self._was_enabled: Optional[bool] = None
+
+    def iteration_done(self, model, iteration, info):
+        self._tracing.instant("iteration", iteration=iteration,
+                              batch_size=info.get("batch_size"))
+
+    def on_epoch_start(self, model, epoch):
+        if self._was_enabled is None:  # prior state, captured at run start
+            self._was_enabled = self._tracing.is_enabled()
+        self._tracing.enable(True)
+
+    def on_epoch_end(self, model, epoch):
+        tracer = self._tracing.get_tracer()
+        if self.jsonl_path:
+            tracer.write_jsonl(self.jsonl_path)
+        if self.chrome_path:
+            tracer.write_chrome_trace(self.chrome_path)
+        if self._restore:
+            self._tracing.enable(bool(self._was_enabled))
+
+    def on_fit_end(self, model):
+        # runs in the fit loop's finally: a fit that raises mid-epoch
+        # must still restore the process-global flag (and leave the
+        # artifacts covering what WAS captured) — otherwise every other
+        # net in the process inherits per-step device syncs forever
+        if self._was_enabled is None:
+            return  # fit never started an epoch
+        if self.jsonl_path:
+            self._tracing.get_tracer().write_jsonl(self.jsonl_path)
+        if self.chrome_path:
+            self._tracing.get_tracer().write_chrome_trace(self.chrome_path)
+        if self._restore:
+            self._tracing.enable(bool(self._was_enabled))
 
 
 class ComposableIterationListener(IterationListener):

@@ -6,6 +6,7 @@ boilerplate the four servers would otherwise each re-implement."""
 from __future__ import annotations
 
 import json
+import math
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional, Tuple
@@ -15,8 +16,30 @@ from typing import Callable, Optional, Tuple
 Handler = Callable[[str, bytes, dict], Optional[Tuple[int, str, bytes]]]
 
 
+def _finite(obj):
+    """Replace non-finite floats with None, recursively. json.dumps
+    serializes float("nan") as bare `NaN`, which is NOT JSON — strict
+    parsers (and most non-Python clients) reject the whole body. An
+    idle endpoint's percentile fields are the canonical trigger: a
+    /metrics scrape before the first request must still parse."""
+    if isinstance(obj, float):
+        return obj if math.isfinite(obj) else None
+    if isinstance(obj, dict):
+        return {k: _finite(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_finite(v) for v in obj]
+    return obj
+
+
 def json_response(obj, code: int = 200) -> Tuple[int, str, bytes]:
-    return code, "application/json", json.dumps(obj).encode()
+    # common case (all-finite payloads, e.g. large /predict bodies) stays
+    # on the C-speed serializer; only a non-finite payload pays the
+    # Python-level _finite walk
+    try:
+        payload = json.dumps(obj, allow_nan=False)
+    except ValueError:
+        payload = json.dumps(_finite(obj), allow_nan=False)
+    return code, "application/json", payload.encode()
 
 
 def html_response(text: str, code: int = 200) -> Tuple[int, str, bytes]:

@@ -1,0 +1,412 @@
+"""Process-global metrics registry — the one place every layer's
+counters live (Prometheus-style pull model; Dapper-paper sibling
+utils/tracing.py covers the span side).
+
+Before this module the framework's telemetry was fragmented: serving
+kept private dicts (parallel/inference.py `_stats`), training throughput
+lived in listeners, per-net `output_compile_count` was an attribute you
+had to know about, and the Helper SPI's auto-disable events existed only
+as log lines. Here everything funnels into one thread-safe
+MetricsRegistry so a single scrape — `InferenceServer GET
+/metrics?format=prometheus`, `cli metrics`, or a bench snapshot — sees
+training-side (`fit_step_*`, `compile_total`, `helper_*`) and
+serving-side (`serving_*`) series from the same process.
+
+Model (deliberately the Prometheus one, minus the client_library
+dependency this container doesn't have):
+
+* a metric NAME identifies a *family* (`Counter`, `Gauge`, `Histogram`)
+  with fixed label names; `family.labels("a", "b")` returns the child
+  for one label-value tuple (cached — hot paths hold the child, never
+  re-look-up the family).
+* `Counter.inc()`, `Gauge.set()/set_function()`, `Histogram.observe()`
+  are the only write paths; all are lock-protected and safe from any
+  thread (serving worker threads, the PS drain thread, SIGTERM
+  checkpoint saves).
+* `registry.snapshot()` is the JSON view (strictly finite numbers —
+  utils/jsonhttp refuses NaN); `registry.to_prometheus()` is the text
+  exposition (label escaping, `_total` counter suffix, cumulative
+  `_bucket{le=...}` histograms).
+
+Histograms use fixed log-scale buckets (seconds-oriented by default:
+100 µs .. 100 s) for the exposition plus a bounded window of raw
+observations for p50/p99 readout, reusing utils/latency.py's
+nearest-rank percentile — the same numbers an operator already gets
+from LatencyTracker, now for every timed phase in the framework.
+"""
+
+from __future__ import annotations
+
+import bisect
+import math
+import threading
+from collections import deque
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from deeplearning4j_tpu.utils.latency import percentile
+
+# default log-scale bucket bounds (seconds): 1e-4 .. 1e2 at 1/2.5/5 per
+# decade — wide enough for a 100 µs dispatch and a 90 s checkpoint save
+DEFAULT_BUCKETS = tuple(
+    m * 10.0 ** e for e in range(-4, 3) for m in (1.0, 2.5, 5.0)
+)
+
+
+def _check_labels(values: Sequence[str], names: Tuple[str, ...]):
+    if len(values) != len(names):
+        raise ValueError(
+            f"expected {len(names)} label values for {names}, "
+            f"got {len(values)}")
+    return tuple(str(v) for v in values)
+
+
+def escape_label_value(v: str) -> str:
+    """Prometheus text-format label escaping: backslash, quote, newline."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _fmt(v: float) -> str:
+    """Exposition value formatting: integral floats without the trailing
+    .0 noise (Prometheus accepts either; diffs read better)."""
+    f = float(v)
+    if math.isnan(f):
+        return "NaN"
+    if math.isinf(f):
+        return "+Inf" if f > 0 else "-Inf"
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+class _Child:
+    __slots__ = ("_lock",)
+
+    def __init__(self):
+        self._lock = threading.Lock()
+
+
+class CounterChild(_Child):
+    __slots__ = ("_value",)
+
+    def __init__(self):
+        super().__init__()
+        self._value = 0.0
+
+    def inc(self, amount: float = 1.0):
+        if amount < 0:
+            raise ValueError("counters only go up; use a Gauge")
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+class GaugeChild(_Child):
+    __slots__ = ("_value", "_fn")
+
+    def __init__(self):
+        super().__init__()
+        self._value = 0.0
+        self._fn: Optional[Callable[[], float]] = None
+
+    def set(self, value: float):
+        with self._lock:
+            self._fn = None
+            self._value = float(value)
+
+    def inc(self, amount: float = 1.0):
+        with self._lock:
+            self._value += amount
+
+    def dec(self, amount: float = 1.0):
+        self.inc(-amount)
+
+    def set_function(self, fn: Callable[[], float]):
+        """Evaluate `fn` at read time (queue depths and other
+        point-in-time facts — no hot-path writes at all)."""
+        with self._lock:
+            self._fn = fn
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            fn = self._fn
+            if fn is None:
+                return self._value
+        try:
+            return float(fn())
+        except Exception:  # a dead callback must not kill a scrape
+            return float("nan")
+
+
+class HistogramChild(_Child):
+    __slots__ = ("_bounds", "_counts", "_count", "_sum", "_window")
+
+    def __init__(self, bounds: Tuple[float, ...], window: int = 2048):
+        super().__init__()
+        self._bounds = bounds
+        self._counts = [0] * (len(bounds) + 1)  # +1: the +Inf bucket
+        self._count = 0
+        self._sum = 0.0
+        self._window = deque(maxlen=window)
+
+    def observe(self, value: float):
+        v = float(value)
+        i = bisect.bisect_left(self._bounds, v)
+        with self._lock:
+            self._counts[i] += 1
+            self._count += 1
+            self._sum += v
+            self._window.append(v)
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    @property
+    def sum(self) -> float:
+        with self._lock:
+            return self._sum
+
+    def percentile(self, q: float) -> Optional[float]:
+        """Nearest-rank percentile over the recent-observation window
+        (latency.py semantics); None when nothing was observed."""
+        with self._lock:
+            vals = sorted(self._window)
+        return percentile(vals, q) if vals else None
+
+    def cumulative_buckets(self) -> List[Tuple[float, int]]:
+        """[(upper_bound, cumulative_count)] including (+Inf, count)."""
+        with self._lock:
+            counts = list(self._counts)
+        out, acc = [], 0
+        for bound, c in zip(self._bounds, counts):
+            acc += c
+            out.append((bound, acc))
+        out.append((float("inf"), acc + counts[-1]))
+        return out
+
+
+_KINDS = {"counter": CounterChild, "gauge": GaugeChild,
+          "histogram": HistogramChild}
+
+
+class MetricFamily:
+    """One named metric + its labeled children. Constructed only via the
+    registry's counter()/gauge()/histogram() get-or-create methods."""
+
+    def __init__(self, name: str, kind: str, help: str = "",
+                 labelnames: Sequence[str] = (),
+                 buckets: Optional[Sequence[float]] = None,
+                 window: int = 2048):
+        self.name = name
+        self.kind = kind
+        self.help = help
+        self.labelnames = tuple(labelnames)
+        self._buckets = (tuple(sorted(buckets)) if buckets is not None
+                         else DEFAULT_BUCKETS)
+        self._window = window
+        self._lock = threading.Lock()
+        self._children: Dict[Tuple[str, ...], _Child] = {}
+
+    def labels(self, *values, **kv):
+        if kv:
+            if values:
+                raise ValueError("pass label values positionally OR by name")
+            values = tuple(kv[n] for n in self.labelnames)
+        key = _check_labels(values, self.labelnames)
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                if self.kind == "histogram":
+                    child = HistogramChild(self._buckets, self._window)
+                else:
+                    child = _KINDS[self.kind]()
+                self._children[key] = child
+        return child
+
+    # label-less families proxy the single () child so call sites read
+    # `reg.counter("fit_step_total").inc()` without a labels() hop
+    def inc(self, amount: float = 1.0):
+        self.labels().inc(amount)
+
+    def set(self, value: float):
+        self.labels().set(value)
+
+    def dec(self, amount: float = 1.0):
+        self.labels().dec(amount)
+
+    def set_function(self, fn: Callable[[], float]):
+        self.labels().set_function(fn)
+
+    def observe(self, value: float):
+        self.labels().observe(value)
+
+    @property
+    def value(self):
+        return self.labels().value
+
+    @property
+    def count(self):
+        return self.labels().count
+
+    def percentile(self, q: float):
+        return self.labels().percentile(q)
+
+    def children(self) -> List[Tuple[Tuple[str, ...], _Child]]:
+        with self._lock:
+            return list(self._children.items())
+
+
+class MetricsRegistry:
+    """Thread-safe name -> MetricFamily map with get-or-create
+    registration (re-registering with the same type returns the existing
+    family, so modules can resolve their instruments independently)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._families: Dict[str, MetricFamily] = {}
+
+    def _get_or_create(self, name: str, kind: str, help: str,
+                       labelnames: Sequence[str],
+                       buckets: Optional[Sequence[float]] = None,
+                       window: int = 2048) -> MetricFamily:
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is not None:
+                if fam.kind != kind:
+                    raise ValueError(
+                        f"metric {name!r} already registered as {fam.kind}, "
+                        f"not {kind}")
+                if tuple(labelnames) != fam.labelnames:
+                    raise ValueError(
+                        f"metric {name!r} already registered with labels "
+                        f"{fam.labelnames}, not {tuple(labelnames)}")
+                if (buckets is not None
+                        and tuple(sorted(buckets)) != fam._buckets):
+                    # an EXPLICIT bucket set that silently lands in the
+                    # first registrant's bounds is wrong exposition;
+                    # omitting buckets means "whatever exists" (the
+                    # percentile window is first-registrant-wins)
+                    raise ValueError(
+                        f"histogram {name!r} already registered with "
+                        f"buckets {fam._buckets}, not "
+                        f"{tuple(sorted(buckets))}")
+                return fam
+            fam = MetricFamily(name, kind, help, labelnames, buckets, window)
+            self._families[name] = fam
+            return fam
+
+    def counter(self, name: str, help: str = "",
+                labelnames: Sequence[str] = ()) -> MetricFamily:
+        return self._get_or_create(name, "counter", help, labelnames)
+
+    def gauge(self, name: str, help: str = "",
+              labelnames: Sequence[str] = ()) -> MetricFamily:
+        return self._get_or_create(name, "gauge", help, labelnames)
+
+    def histogram(self, name: str, help: str = "",
+                  labelnames: Sequence[str] = (),
+                  buckets: Optional[Sequence[float]] = None,
+                  window: int = 2048) -> MetricFamily:
+        return self._get_or_create(name, "histogram", help, labelnames,
+                                   buckets, window)
+
+    def get(self, name: str) -> Optional[MetricFamily]:
+        with self._lock:
+            return self._families.get(name)
+
+    def unregister(self, name: str):
+        with self._lock:
+            self._families.pop(name, None)
+
+    def reset(self):
+        """Drop every family (tests). Live code that cached children keeps
+        incrementing them, but they no longer appear in snapshots — so
+        production code never calls this."""
+        with self._lock:
+            self._families.clear()
+
+    # -- readout -------------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """JSON-safe dict view: {name: {"type", "help", "values": [...]}}.
+        All numbers are finite or None (percentiles of an empty window) —
+        json.dumps(..., allow_nan=False) always succeeds."""
+        with self._lock:
+            fams = list(self._families.values())
+        out = {}
+        for fam in sorted(fams, key=lambda f: f.name):
+            values = []
+            for key, child in sorted(fam.children()):
+                labels = dict(zip(fam.labelnames, key))
+                if fam.kind == "histogram":
+                    count = child.count
+                    values.append({
+                        "labels": labels,
+                        "count": count,
+                        "sum": round(child.sum, 9),
+                        "p50": child.percentile(50),
+                        "p99": child.percentile(99),
+                        "buckets": [
+                            ["+Inf" if math.isinf(le) else le, c]
+                            for le, c in child.cumulative_buckets()
+                        ],
+                    })
+                else:
+                    v = child.value
+                    values.append({
+                        "labels": labels,
+                        "value": None if (isinstance(v, float)
+                                          and not math.isfinite(v)) else v,
+                    })
+            out[fam.name] = {"type": fam.kind, "help": fam.help,
+                             "values": values}
+        return out
+
+    def to_prometheus(self) -> str:
+        """Text exposition (format 0.0.4). Counters are suffixed `_total`
+        when the registered name doesn't already end that way; histograms
+        expand to `_bucket{le=...}` / `_sum` / `_count`."""
+        with self._lock:
+            fams = list(self._families.values())
+        lines: List[str] = []
+        for fam in sorted(fams, key=lambda f: f.name):
+            name = fam.name
+            if fam.kind == "counter" and not name.endswith("_total"):
+                name += "_total"
+            if fam.help:
+                lines.append(f"# HELP {name} "
+                             + fam.help.replace("\\", "\\\\")
+                                       .replace("\n", "\\n"))
+            lines.append(f"# TYPE {name} {fam.kind}")
+            for key, child in sorted(fam.children()):
+                pairs = [f'{n}="{escape_label_value(v)}"'
+                         for n, v in zip(fam.labelnames, key)]
+                base_lab = ",".join(pairs)
+                if fam.kind == "histogram":
+                    for le, c in child.cumulative_buckets():
+                        lab = base_lab + ("," if base_lab else "") \
+                            + f'le="{_fmt(le)}"'
+                        lines.append(f"{name}_bucket{{{lab}}} {c}")
+                    suffix = f"{{{base_lab}}}" if base_lab else ""
+                    lines.append(f"{name}_sum{suffix} {_fmt(child.sum)}")
+                    lines.append(f"{name}_count{suffix} {child.count}")
+                else:
+                    suffix = f"{{{base_lab}}}" if base_lab else ""
+                    lines.append(f"{name}{suffix} {_fmt(child.value)}")
+        return "\n".join(lines) + "\n"
+
+
+# -- the process-global registry ---------------------------------------------
+
+_REGISTRY = MetricsRegistry()
+
+
+def get_registry() -> MetricsRegistry:
+    return _REGISTRY

@@ -1,0 +1,233 @@
+"""Host-side span tracing — the Dapper-style request/step half of the
+observability layer (counters live in utils/metrics.py).
+
+A span is a named, timed section of host code with a thread-local parent
+stack, so `span("fit/step")` containing `span("fit/device_sync")` nests
+the way Dapper trees do. Completed spans land in a bounded ring buffer
+(old traffic ages out; a serving process never grows without bound) and
+export two ways:
+
+* JSONL — one span per line, newest last (`InferenceServer GET /trace`,
+  `TracingListener(jsonl_path=...)`); greppable, tail-able.
+* Chrome trace event JSON — load the dict from `to_chrome_trace()` into
+  chrome://tracing / Perfetto and the host timeline sits next to the
+  device xplane timeline captured by utils/profiler.py.
+
+Device correlation: when enabled, each span also enters
+`jax.profiler.TraceAnnotation(name)`, so the SAME names show up inside a
+`jax.profiler.trace()` capture — `cli profile` op tables and host spans
+line up by name.
+
+Overhead contract: tracing is OFF by default and `span()` on the
+disabled path returns a shared no-op context manager after one flag
+check — no allocation, no lock, no clock read. The fit loop's phase
+timers depend on this (ISSUE acceptance: ≤2% step-time regression with
+tracing disabled).
+"""
+
+from __future__ import annotations
+
+import json
+import itertools
+import threading
+import time
+from collections import deque
+from typing import List, Optional
+
+_counter = itertools.count(1)
+_tls = threading.local()
+
+
+class _NullSpan:
+    """Shared disabled-path context manager: truthy checks, enter/exit
+    no-ops, one instance for the whole process."""
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    __slots__ = ("tracer", "name", "args", "id", "parent", "t0", "_ann")
+
+    def __init__(self, tracer: "Tracer", name: str, args: Optional[dict]):
+        self.tracer = tracer
+        self.name = name
+        self.args = args
+        self.id = next(_counter)
+        self.parent = None
+        self.t0 = 0.0
+        self._ann = None
+
+    def __enter__(self):
+        stack = getattr(_tls, "stack", None)
+        if stack is None:
+            stack = _tls.stack = []
+        self.parent = stack[-1].id if stack else None
+        stack.append(self)
+        if self.tracer.annotate_device:
+            ann = _trace_annotation(self.name)
+            if ann is not None:
+                self._ann = ann
+                ann.__enter__()
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        t1 = time.perf_counter()
+        if self._ann is not None:
+            self._ann.__exit__(*exc)
+        stack = getattr(_tls, "stack", None)
+        if stack and stack[-1] is self:
+            stack.pop()
+        self.tracer._record(self.name, self.t0, t1 - self.t0, self.id,
+                            self.parent, self.args)
+        return False
+
+
+def _trace_annotation(name: str):
+    """jax.profiler.TraceAnnotation(name) or None when jax (or the
+    profiler module) is unavailable — tracing must work in a stub
+    environment."""
+    try:
+        from jax.profiler import TraceAnnotation
+    except Exception:
+        return None
+    try:
+        return TraceAnnotation(name)
+    except Exception:
+        return None
+
+
+class Tracer:
+    """Bounded ring buffer of completed spans + the enable switch."""
+
+    def __init__(self, capacity: int = 8192, annotate_device: bool = True):
+        self.enabled = False
+        self.annotate_device = annotate_device
+        self._lock = threading.Lock()
+        self._events: deque = deque(maxlen=int(capacity))
+        # perf_counter origin so exported timestamps are relative to
+        # tracer creation (chrome trace wants microseconds, any epoch)
+        self._epoch = time.perf_counter()
+
+    # -- recording -----------------------------------------------------------
+
+    def span(self, name: str, **args):
+        """Context manager timing a section. Disabled -> shared no-op."""
+        if not self.enabled:
+            return NULL_SPAN
+        return _Span(self, name, args or None)
+
+    def instant(self, name: str, **args):
+        """Zero-duration marker event (compile-cache insertions, helper
+        auto-disables, ...)."""
+        if not self.enabled:
+            return
+        stack = getattr(_tls, "stack", None)
+        parent = stack[-1].id if stack else None
+        self._record(name, time.perf_counter(), 0.0, next(_counter),
+                     parent, args or None, phase="i")
+
+    def _record(self, name, t0, dur, span_id, parent, args, phase="X"):
+        ev = {
+            "name": name,
+            "ph": phase,
+            "ts": round((t0 - self._epoch) * 1e6, 3),  # microseconds
+            "dur": round(dur * 1e6, 3),
+            "id": span_id,
+            "parent": parent,
+            "tid": threading.get_ident(),
+        }
+        if args:
+            ev["args"] = args
+        with self._lock:
+            self._events.append(ev)
+
+    # -- readout -------------------------------------------------------------
+
+    def recent(self, n: Optional[int] = None) -> List[dict]:
+        """The n newest events (all when n is None, none when n <= 0 —
+        a negative slice must never invert into 'everything BUT the
+        newest n')."""
+        with self._lock:
+            evs = list(self._events)
+        if n is None:
+            return evs
+        n = int(n)
+        return evs[-n:] if n > 0 else []
+
+    def clear(self):
+        with self._lock:
+            self._events.clear()
+
+    def to_jsonl(self, n: Optional[int] = None) -> str:
+        return "\n".join(json.dumps(ev) for ev in self.recent(n)) + "\n"
+
+    def to_chrome_trace(self) -> dict:
+        """chrome://tracing / Perfetto "trace event format" document."""
+        events = []
+        for ev in self.recent():
+            ce = {
+                "name": ev["name"],
+                "ph": ev["ph"],
+                "ts": ev["ts"],
+                "pid": 1,
+                "tid": ev["tid"],
+            }
+            if ev["ph"] == "X":
+                ce["dur"] = ev["dur"]
+            else:
+                ce["s"] = "t"  # instant scope: thread
+            args = dict(ev.get("args") or {})
+            args["span_id"] = ev["id"]
+            if ev.get("parent") is not None:
+                args["parent_span_id"] = ev["parent"]
+            ce["args"] = args
+            events.append(ce)
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+    def write_chrome_trace(self, path: str) -> str:
+        with open(path, "w") as f:
+            json.dump(self.to_chrome_trace(), f)
+        return path
+
+    def write_jsonl(self, path: str) -> str:
+        with open(path, "w") as f:
+            f.write(self.to_jsonl())
+        return path
+
+
+# -- the process-global tracer ------------------------------------------------
+
+_TRACER = Tracer()
+
+
+def get_tracer() -> Tracer:
+    return _TRACER
+
+
+def enable(flag: bool = True):
+    """Turn span recording on/off process-wide."""
+    _TRACER.enabled = bool(flag)
+
+
+def is_enabled() -> bool:
+    return _TRACER.enabled
+
+
+def span(name: str, **args):
+    """Module-level shortcut: `with tracing.span("fit/step"): ...`."""
+    if not _TRACER.enabled:
+        return NULL_SPAN
+    return _TRACER.span(name, **args)
+
+
+def instant(name: str, **args):
+    _TRACER.instant(name, **args)

@@ -16,6 +16,33 @@
 # what keeps tier-1 fast.
 set -o pipefail
 cd "$(dirname "$0")/.."
+
+# -- print-lint guard --------------------------------------------------------
+# Library code must log via the "deeplearning4j_tpu" logger, not print
+# (deeplearning4j_tpu/__init__.py configure_logging). New `print(` call
+# sites in deeplearning4j_tpu/ outside cli.py fail the run; existing ones
+# are grandfathered per-file in scripts/print_baseline.txt.
+lint_fail=0
+while IFS= read -r entry; do
+    file=${entry%%:*}
+    count=${entry##*:}
+    [ "$file" = "deeplearning4j_tpu/cli.py" ] && continue
+    allowed=$(awk -v f="$file" '$2 == f {print $1}' scripts/print_baseline.txt)
+    allowed=${allowed:-0}
+    if [ "$count" -gt "$allowed" ]; then
+        echo "T1 LINT: $file has $count print( calls (baseline $allowed) —" \
+             "use the deeplearning4j_tpu logger, or update scripts/print_baseline.txt"
+        lint_fail=1
+    fi
+done < <(grep -rcE '(^|[^A-Za-z0-9_.])print\(' --include='*.py' deeplearning4j_tpu/ | awk -F: '$2 > 0')
+if [ "$lint_fail" -ne 0 ]; then
+    exit 1
+fi
+
+# -- the canonical tier-1 pytest run -----------------------------------------
+# T1_METRICS_DUMP=1 makes tests/conftest.py write the shared metrics
+# registry's snapshot after the session (T1_METRICS_ARTIFACT, default
+# /tmp/_t1_metrics.json) — diff compile counts across PRs.
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
 rc=${PIPESTATUS[0]}
@@ -40,6 +67,9 @@ if [ -n "$new_failures" ]; then
     echo "T1 REGRESSIONS — failing tests not in $baseline:"
     echo "$new_failures"
     exit 1
+fi
+if [ -n "${T1_METRICS_DUMP:-}" ]; then
+    echo "T1 metrics snapshot: ${T1_METRICS_ARTIFACT:-/tmp/_t1_metrics.json}"
 fi
 echo "T1 OK: $(wc -l < "$artifact" | tr -d ' ') failing (all within the $(wc -l < "$baseline" | tr -d ' ')-name baseline); artifact: $artifact"
 exit 0

@@ -36,6 +36,24 @@ def pytest_configure(config):
         "markers", "slow: long-running tests (multi-process, large fits)")
 
 
+def pytest_sessionfinish(session, exitstatus):
+    # Opt-in observability artifact (scripts/t1.sh T1_METRICS_DUMP=1):
+    # dump the process-global metrics registry after the run so compile
+    # counts / helper events can be diffed across PRs.
+    if not os.environ.get("T1_METRICS_DUMP"):
+        return
+    import json
+
+    from deeplearning4j_tpu.utils.metrics import get_registry
+
+    path = os.environ.get("T1_METRICS_ARTIFACT", "/tmp/_t1_metrics.json")
+    try:
+        with open(path, "w") as f:
+            json.dump(get_registry().snapshot(), f, indent=2, sort_keys=True)
+    except Exception as e:  # an artifact failure must not fail the suite
+        print(f"[conftest] metrics dump failed: {e}", file=sys.stderr)
+
+
 @pytest.fixture
 def rng_key():
     import jax

@@ -1,0 +1,599 @@
+"""Observability-layer tests: the shared MetricsRegistry (thread safety,
+histogram math vs numpy, Prometheus exposition format), the span tracer
+(nesting, chrome-trace export, disabled-path cost), and the cross-layer
+wiring — Helper SPI fallback counters (the PR 2 auto-disable regression),
+fit-loop step-phase instruments with the zero-registry-lookups-per-step
+overhead guard, and the inference server's strict-JSON /metrics plus the
+one-scrape-sees-training-AND-serving Prometheus acceptance criterion."""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+import deeplearning4j_tpu as dl4j
+from deeplearning4j_tpu.nn.conf import (
+    DenseLayer,
+    NeuralNetConfiguration,
+    OutputLayer,
+    Updater,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.ops import helpers
+from deeplearning4j_tpu.utils import metrics as metrics_mod
+from deeplearning4j_tpu.utils import tracing
+from deeplearning4j_tpu.utils.jsonhttp import json_response
+from deeplearning4j_tpu.utils.metrics import MetricsRegistry
+
+
+@pytest.fixture(autouse=True)
+def _tracing_off_after():
+    """Tracing is process-global state; never leak an enabled tracer (or
+    a dirty span buffer) into other tests."""
+    yield
+    tracing.enable(False)
+    tracing.get_tracer().clear()
+
+
+def _mlp_conf(seed=7, n_in=12):
+    return (
+        NeuralNetConfiguration.builder()
+        .seed(seed)
+        .updater(Updater.SGD)
+        .learning_rate(0.05)
+        .weight_init("xavier")
+        .list()
+        .layer(DenseLayer(n_in=n_in, n_out=16, activation="tanh"))
+        .layer(OutputLayer(n_in=16, n_out=4, activation="softmax",
+                           loss="mcxent"))
+        .build()
+    )
+
+
+def _xy(n=32, n_in=12, n_out=4, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, n_in)).astype(np.float32)
+    y = np.eye(n_out, dtype=np.float32)[rng.integers(0, n_out, n)]
+    return x, y
+
+
+# -- registry core -----------------------------------------------------------
+
+def test_counter_thread_safety():
+    reg = MetricsRegistry()
+    c = reg.counter("hits_total", "x", ("who",))
+    child = c.labels("a")
+
+    def worker():
+        for _ in range(1000):
+            child.inc()
+            c.labels("b").inc(2)
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert child.value == 8000
+    assert c.labels("b").value == 16000
+
+
+def test_counter_is_monotonic_and_typed():
+    reg = MetricsRegistry()
+    c = reg.counter("ops_total")
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    # get-or-create returns the same family; kind conflicts are errors
+    assert reg.counter("ops_total") is c
+    with pytest.raises(ValueError, match="already registered"):
+        reg.gauge("ops_total")
+    with pytest.raises(ValueError, match="labels"):
+        reg.counter("ops_total", labelnames=("x",))
+
+
+def test_gauge_set_function_and_dead_callback():
+    reg = MetricsRegistry()
+    g = reg.gauge("depth")
+    g.set(3)
+    assert g.value == 3
+    g.set_function(lambda: 7)
+    assert g.value == 7
+    g.set_function(lambda: 1 / 0)  # a dying callback must not kill a scrape
+    snap = reg.snapshot()
+    assert snap["depth"]["values"][0]["value"] is None  # NaN -> null
+    json.dumps(snap, allow_nan=False)
+
+
+def test_histogram_percentiles_vs_numpy():
+    reg = MetricsRegistry()
+    h = reg.histogram("lat_seconds", window=10_000)
+    rng = np.random.default_rng(42)
+    vals = rng.lognormal(mean=-5, sigma=1.0, size=2000)
+    for v in vals:
+        h.observe(float(v))
+    child = h.labels()
+    assert child.count == 2000
+    assert child.sum == pytest.approx(vals.sum(), rel=1e-9)
+    # nearest-rank percentile over the full window vs numpy's
+    for q in (50, 90, 99):
+        got = child.percentile(q)
+        lo, hi = np.percentile(vals, max(q - 1, 0)), np.percentile(
+            vals, min(q + 1, 100))
+        assert lo <= got <= hi
+
+
+def test_histogram_bucket_counts_exact():
+    reg = MetricsRegistry()
+    h = reg.histogram("d_seconds", buckets=(0.01, 0.1, 1.0))
+    for v in (0.005, 0.01, 0.05, 0.5, 5.0):
+        h.observe(v)
+    cum = h.labels().cumulative_buckets()
+    # le semantics: 0.01 counts the exact-boundary observation
+    assert cum == [(0.01, 2), (0.1, 3), (1.0, 4), (float("inf"), 5)]
+
+
+def test_prometheus_exposition_format():
+    reg = MetricsRegistry()
+    reg.counter("requests", "served requests", ("route",)) \
+        .labels('with"quote\\and\nnewline').inc(3)
+    reg.gauge("depth", "queue depth").set(2)
+    h = reg.histogram("lat_seconds", "latency", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    text = reg.to_prometheus()
+    # counters get the _total suffix when the name lacks it
+    assert "# TYPE requests_total counter" in text
+    assert 'requests_total{route="with\\"quote\\\\and\\nnewline"} 3' in text
+    assert "# TYPE depth gauge" in text
+    assert "depth 2" in text.splitlines()
+    # histogram expansion: cumulative buckets incl +Inf, _sum, _count
+    assert 'lat_seconds_bucket{le="0.1"} 1' in text
+    assert 'lat_seconds_bucket{le="1"} 2' in text
+    assert 'lat_seconds_bucket{le="+Inf"} 2' in text
+    assert "lat_seconds_count 2" in text.splitlines()
+    assert any(line.startswith("lat_seconds_sum ")
+               for line in text.splitlines())
+    assert "# HELP requests_total served requests" in text
+
+
+def test_snapshot_is_strict_json():
+    reg = MetricsRegistry()
+    reg.histogram("empty_seconds")  # family with no observations
+    reg.histogram("one_seconds").observe(0.25)
+    s = json.dumps(reg.snapshot(), allow_nan=False)
+    doc = json.loads(s)
+    one = doc["one_seconds"]["values"][0]
+    assert one["count"] == 1 and one["p50"] == 0.25
+    assert doc["empty_seconds"]["values"] == []
+
+
+# -- tracing -----------------------------------------------------------------
+
+def test_span_disabled_is_free_singleton():
+    tracing.enable(False)
+    s1, s2 = tracing.span("a"), tracing.span("b", k=1)
+    assert s1 is s2 is tracing.NULL_SPAN
+    with s1:
+        pass
+    tracing.instant("nope")
+    assert tracing.get_tracer().recent() == []
+
+
+def test_span_nesting_and_chrome_roundtrip(tmp_path):
+    tracer = tracing.get_tracer()
+    tracer.clear()
+    tracing.enable(True)
+    with tracing.span("outer", phase="x"):
+        with tracing.span("inner"):
+            pass
+        tracing.instant("marker", it=3)
+    evs = tracer.recent()
+    tracing.enable(False)
+    by_name = {e["name"]: e for e in evs}
+    assert set(by_name) == {"outer", "inner", "marker"}
+    # children close (and record) before the parent; parent ids link up
+    assert by_name["inner"]["parent"] == by_name["outer"]["id"]
+    assert by_name["marker"]["parent"] == by_name["outer"]["id"]
+    assert by_name["outer"]["parent"] is None
+    assert by_name["outer"]["dur"] >= by_name["inner"]["dur"] >= 0
+    # chrome-trace export round-trips through strict JSON
+    path = tmp_path / "trace.json"
+    tracer.write_chrome_trace(str(path))
+    doc = json.loads(path.read_text())
+    names = [e["name"] for e in doc["traceEvents"]]
+    assert set(names) == {"outer", "inner", "marker"}
+    marker = next(e for e in doc["traceEvents"] if e["name"] == "marker")
+    assert marker["ph"] == "i" and marker["args"]["it"] == 3
+    # JSONL export: one strict-JSON object per line
+    for line in tracer.to_jsonl().strip().splitlines():
+        json.loads(line)
+
+
+def test_tracing_listener_writes_artifacts(tmp_path):
+    from deeplearning4j_tpu.train.listeners import TracingListener
+
+    tracing.get_tracer().clear()
+    net = MultiLayerNetwork(_mlp_conf()).init()
+    jsonl = tmp_path / "spans.jsonl"
+    chrome = tmp_path / "spans.chrome.json"
+    lst = TracingListener(jsonl_path=str(jsonl), chrome_path=str(chrome))
+    # construction must NOT flip the process-global flag (that would
+    # impose the per-step device sync on every other net in the process)
+    assert not tracing.is_enabled()
+    net.set_listeners(lst)
+    x, y = _xy(n=16)
+    net.fit(x, y, epochs=2, batch_size=8, async_prefetch=False)
+    assert not tracing.is_enabled()  # restored
+    lines = [json.loads(l) for l in jsonl.read_text().strip().splitlines()]
+    names = {e["name"] for e in lines}
+    assert "fit/step" in names and "iteration" in names
+    assert "fit/device_sync" in names  # tracing was on -> sync measured
+    # restore_on_epoch_end must NOT leave later epochs untraced: all 4
+    # steps (2 epochs x 2 batches) recorded spans
+    assert sum(e["name"] == "fit/step" for e in lines) == 4
+    iters = {e["args"]["iteration"] for e in lines
+             if e["name"] == "iteration"}
+    assert iters == {0, 1, 2, 3}
+    doc = json.loads(chrome.read_text())
+    assert any(e["name"] == "fit/step" for e in doc["traceEvents"])
+
+
+def test_tracing_listener_restores_when_fit_raises():
+    from deeplearning4j_tpu.train.listeners import TracingListener
+
+    class _Boom:
+        def __iter__(self):
+            raise RuntimeError("iterator died")
+
+        def reset(self):
+            pass
+
+    net = MultiLayerNetwork(_mlp_conf()).init()
+    net.set_listeners(TracingListener())
+    with pytest.raises(RuntimeError, match="iterator died"):
+        net._run_fit(_Boom(), epochs=1, async_prefetch=False)
+    # the finally-hook restored the process-global flag despite the raise
+    assert not tracing.is_enabled()
+
+
+def test_recent_rejects_nonpositive_and_histogram_bucket_conflict():
+    tracer = tracing.Tracer()  # local tracer: no global state
+    tracer.enabled = True
+    for i in range(5):
+        with tracer.span(f"s{i}"):
+            pass
+    assert len(tracer.recent()) == 5
+    assert [e["name"] for e in tracer.recent(2)] == ["s3", "s4"]
+    assert tracer.recent(0) == []
+    assert tracer.recent(-3) == []  # must not invert into "all but newest"
+    reg = MetricsRegistry()
+    reg.histogram("x_seconds", buckets=(0.1, 1.0))
+    reg.histogram("x_seconds")  # no explicit buckets: existing family ok
+    with pytest.raises(ValueError, match="buckets"):
+        reg.histogram("x_seconds", buckets=(0.001, 0.01))
+
+
+# -- helper SPI counters (PR 2 auto-disable regression) ----------------------
+
+def _counter_value(name, **labels):
+    fam = metrics_mod.get_registry().get(name)
+    if fam is None:
+        return 0.0
+    return fam.labels(**labels).value
+
+
+def test_helper_fallback_counters_on_auto_disable():
+    op = "metrics_test_op"
+
+    def boom(*a, **k):
+        raise RuntimeError("kernel exploded at trace time")
+
+    helpers.register_helper(op, boom, name="boomer")
+    try:
+        before_dis = _counter_value("helper_auto_disable_total",
+                                    op=op, helper="boomer")
+        before_raised = _counter_value("helper_fallback_total",
+                                       op=op, helper="boomer",
+                                       reason="raised")
+        fn = helpers.get_helper(op)
+        assert fn is not None
+        assert _counter_value("helper_hit_total",
+                              op=op, helper="boomer") >= 1
+        with pytest.raises(helpers.HelperError):
+            fn(1, 2)
+        assert _counter_value("helper_auto_disable_total", op=op,
+                              helper="boomer") == before_dis + 1
+        assert _counter_value("helper_fallback_total", op=op,
+                              helper="boomer",
+                              reason="raised") == before_raised + 1
+        # the helper is now disabled: the next lookup falls back, counted
+        assert helpers.get_helper(op) is None
+        assert _counter_value("helper_fallback_total", op=op,
+                              helper="boomer", reason="disabled") >= 1
+    finally:
+        helpers._HELPERS.pop(op, None)
+
+
+def test_helper_unsupported_fallback_counted():
+    op = "metrics_test_unsup"
+    helpers.register_helper(op, lambda: None,
+                            supported=lambda **ctx: False, name="picky")
+    try:
+        before = _counter_value("helper_fallback_total", op=op,
+                                helper="picky", reason="unsupported")
+        assert helpers.get_helper(op) is None
+        assert _counter_value("helper_fallback_total", op=op,
+                              helper="picky",
+                              reason="unsupported") == before + 1
+    finally:
+        helpers._HELPERS.pop(op, None)
+
+
+# -- fit-loop wiring + overhead guard ----------------------------------------
+
+def test_fit_step_metrics_recorded():
+    reg = metrics_mod.get_registry()
+    steps0 = _counter_value("fit_step_total")
+    net = MultiLayerNetwork(_mlp_conf()).init()
+    x, y = _xy(n=40)
+    net.fit(x, y, epochs=2, batch_size=10, async_prefetch=False)
+    assert _counter_value("fit_step_total") == steps0 + 8
+    disp = reg.get("fit_dispatch_seconds").labels()
+    wait = reg.get("fit_data_wait_seconds").labels()
+    assert disp.count >= 8 and wait.count >= 8
+    assert _counter_value("compile_total", kind="train_step") >= 1
+
+
+def test_fit_hot_path_no_registry_lookups_when_disabled(monkeypatch):
+    """The overhead guard, asserted structurally (iteration counts, not
+    wall clock): with tracing disabled and no listeners, a fit's
+    per-step path performs ZERO registry lookups (instruments resolve
+    once) and ZERO device syncs beyond the dispatch itself (the sync
+    histogram stays empty)."""
+    assert not tracing.is_enabled()
+    reg = metrics_mod.get_registry()
+    lookups = []
+    orig = MetricsRegistry._get_or_create
+
+    def counting(self, name, *a, **k):
+        lookups.append(name)
+        return orig(self, name, *a, **k)
+
+    net = MultiLayerNetwork(_mlp_conf()).init()
+    sync_before = reg.histogram("fit_device_sync_seconds").labels().count
+    x, y = _xy(n=200)
+    monkeypatch.setattr(MetricsRegistry, "_get_or_create", counting)
+    net.fit(x, y, epochs=1, batch_size=4, async_prefetch=False)  # 50 steps
+    fit_lookups = [n for n in lookups if n.startswith("fit_")]
+    # instruments resolved at most once each, NOT once per 50 steps
+    assert len(fit_lookups) <= 5, fit_lookups
+    # a second fit reuses the cached children: no new lookups at all
+    lookups.clear()
+    net.fit(x, y, epochs=1, batch_size=4, async_prefetch=False)
+    assert [n for n in lookups if n.startswith("fit_")] == []
+    # tracing disabled -> the device-sync probe never ran
+    assert reg.histogram(
+        "fit_device_sync_seconds").labels().count == sync_before
+
+
+def test_performance_listener_reports_window_etl():
+    from deeplearning4j_tpu.train.listeners import PerformanceListener
+
+    out = []
+    lst = PerformanceListener(frequency=3, print_fn=out.append)
+    for i in range(7):
+        lst.iteration_done(None, i, {"batch_size": 8, "etl_ms": 12.0})
+    assert out, "listener never printed"
+    # averaged over the window, not the last batch's value
+    assert "etl 12.0 ms/iter" in out[0]
+
+
+# -- satellites: logging + strict JSON ---------------------------------------
+
+def test_library_logger_has_null_handler():
+    import logging
+
+    lg = logging.getLogger("deeplearning4j_tpu")
+    assert any(isinstance(h, logging.NullHandler) for h in lg.handlers)
+
+
+def test_configure_logging_json_lines(capsys):
+    import io
+    import logging
+
+    buf = io.StringIO()
+    lg = dl4j.configure_logging(level=logging.INFO, json_lines=True,
+                                stream=buf)
+    try:
+        lg.info("hello %s", "world")
+        rec = json.loads(buf.getvalue().strip().splitlines()[-1])
+        assert rec["message"] == "hello world"
+        assert rec["level"] == "INFO"
+        assert rec["logger"] == "deeplearning4j_tpu"
+        # reconfiguring replaces, not stacks, the handler
+        buf2 = io.StringIO()
+        lg = dl4j.configure_logging(json_lines=False, stream=buf2)
+        assert sum(getattr(h, "_dl4j_tpu_configured", False)
+                   for h in lg.handlers) == 1
+    finally:
+        for h in list(lg.handlers):
+            if getattr(h, "_dl4j_tpu_configured", False):
+                lg.removeHandler(h)
+
+
+def test_json_response_replaces_non_finite():
+    code, ctype, payload = json_response(
+        {"p50": float("nan"), "p99": float("inf"), "ok": 1.5})
+    doc = json.loads(
+        payload.decode(),
+        parse_constant=lambda c: pytest.fail(f"non-strict token {c}"))
+    assert doc == {"p50": None, "p99": None, "ok": 1.5}
+
+
+# -- inference server: strict JSON with zero traffic + shared scrape ---------
+
+def _http_get(port, path):
+    import urllib.request
+
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=30) as r:
+        return r.read().decode()
+
+
+def test_inference_server_metrics_strict_json_zero_traffic():
+    from deeplearning4j_tpu.serving import InferenceServer
+
+    net = MultiLayerNetwork(_mlp_conf()).init()
+    server = InferenceServer(net, port=0)
+    port = server.start()
+    try:
+        body = _http_get(port, "/metrics")
+        doc = json.loads(
+            body,
+            parse_constant=lambda c: pytest.fail(
+                f"non-strict JSON token {c} in /metrics with zero traffic"))
+        assert doc["requests"] == 0
+        assert doc["latency_ms"]["p50_ms"] is None
+    finally:
+        server.stop()
+
+
+def test_prometheus_scrape_spans_training_and_serving():
+    """Acceptance: ONE registry — a /metrics?format=prometheus scrape
+    returns training-side (fit_step_*, helper_*, compile_total) and
+    serving-side (bucket hits, request latency histogram) series from
+    the same process."""
+    from deeplearning4j_tpu.serving import InferenceServer
+
+    # training side (same process)
+    net = MultiLayerNetwork(_mlp_conf()).init()
+    x, y = _xy(n=16)
+    net.fit(x, y, epochs=1, batch_size=8, async_prefetch=False)
+    # a helper event (any op) so helper_* series exist
+    helpers.register_helper("scrape_demo", lambda v: v, name="demo")
+    try:
+        helpers.get_helper("scrape_demo")("ok")
+    finally:
+        helpers._HELPERS.pop("scrape_demo", None)
+
+    serve_net = MultiLayerNetwork(_mlp_conf(seed=11)).init()
+    server = InferenceServer(serve_net, port=0, max_batch_size=8)
+    port = server.start()
+    try:
+        import urllib.request
+
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/predict",
+            data=json.dumps(
+                {"features": np.zeros((3, 12)).tolist()}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=60) as r:
+            assert json.loads(r.read())["predictions"]
+        text = _http_get(port, "/metrics?format=prometheus")
+    finally:
+        server.stop()
+    for family in ("fit_step_total", "compile_total",
+                   "helper_hit_total{helper=\"demo\"",
+                   "serving_requests_total", "serving_bucket_hits_total",
+                   "serving_request_seconds_bucket",
+                   "serving_request_seconds_count", "serving_queue_depth"):
+        assert family.split("{")[0] in text, f"{family} missing from scrape"
+    # and the serving series actually moved
+    assert "serving_requests_total " in text
+    line = next(l for l in text.splitlines()
+                if l.startswith("serving_requests_total"))
+    assert float(line.split()[-1]) >= 1
+
+
+def test_trace_route_serves_recent_spans():
+    from deeplearning4j_tpu.serving import InferenceServer
+
+    tracing.get_tracer().clear()
+    tracing.enable(True)
+    net = MultiLayerNetwork(_mlp_conf()).init()
+    server = InferenceServer(net, port=0)
+    port = server.start()
+    try:
+        import urllib.request
+
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/predict",
+            data=json.dumps(
+                {"features": np.zeros((2, 12)).tolist()}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=60) as r:
+            r.read()
+        body = _http_get(port, "/trace")
+        names = {json.loads(l)["name"]
+                 for l in body.strip().splitlines() if l}
+        assert "serve/predict" in names
+        chrome = json.loads(_http_get(port, "/trace?format=chrome"))
+        assert any(e["name"] == "serve/predict"
+                   for e in chrome["traceEvents"])
+    finally:
+        tracing.enable(False)
+        server.stop()
+
+
+# -- checkpoint + paramserver wiring ----------------------------------------
+
+def test_checkpoint_save_metrics(tmp_path):
+    from deeplearning4j_tpu.train.checkpoint import CheckpointListener
+
+    reg = metrics_mod.get_registry()
+    before = 0.0
+    fam = reg.get("checkpoint_saves_total")
+    if fam is not None:
+        before = fam.labels(reason="manual").value
+    net = MultiLayerNetwork(_mlp_conf()).init()
+    lst = CheckpointListener(str(tmp_path), every_n_epochs=None)
+    assert lst.save(net, reason="manual") is not None
+    assert reg.get("checkpoint_saves_total").labels(
+        reason="manual").value == before + 1
+    assert reg.get("checkpoint_save_seconds").labels().count >= 1
+
+
+def test_paramserver_rpc_metrics():
+    from deeplearning4j_tpu.parallel.paramserver import (
+        EmbeddingParameterServer,
+        EmbeddingPSClient,
+    )
+
+    reg = metrics_mod.get_registry()
+    server = EmbeddingParameterServer(
+        {"syn0": np.zeros((10, 4), np.float32)})
+    port = server.start()
+    try:
+        client = EmbeddingPSClient([f"http://127.0.0.1:{port}"])
+        rows = np.array([1, 3])
+        got = client.pull("syn0", rows)
+        assert got.shape == (2, 4)
+        client.push_async("syn0", rows, np.ones((2, 4), np.float32))
+        client.flush()
+        assert server.pushes_applied == 1
+        assert reg.get("paramserver_rpc_total").labels(
+            route="pull.bin").value >= 1
+        assert reg.get("paramserver_rpc_total").labels(
+            route="push.bin").value >= 1
+        assert reg.get("paramserver_rpc_seconds").labels(
+            route="pull.bin").count >= 1
+        assert reg.get("paramserver_client_rpc_total").labels(
+            route="pull.bin").value >= 1
+    finally:
+        server.stop()
+
+
+# -- cli ---------------------------------------------------------------------
+
+def test_cli_metrics_local_dump(tmp_path, capsys):
+    from deeplearning4j_tpu.cli import main
+
+    metrics_mod.get_registry().counter("cli_demo_total").inc(5)
+    assert main(["metrics"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["cli_demo_total"]["values"][0]["value"] == 5
+    out = tmp_path / "m.prom"
+    assert main(["metrics", "--format", "prometheus",
+                 "--output", str(out)]) == 0
+    assert "cli_demo_total 5" in out.read_text().splitlines()
