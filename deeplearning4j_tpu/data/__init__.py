@@ -1,8 +1,12 @@
-"""Data pipeline: DataSet container, iterators, async prefetch, dataset
-fetchers.
+"""Data pipeline: DataSet container, iterators, the staged input pipeline
+(multi-worker ETL, device-resident prefetch, on-device batch transforms),
+dataset fetchers.
 
 Analog of the reference's DataSet/DataSetIterator framework
-(deeplearning4j-nn datasets/ + deeplearning4j-core datasets/iterator/impl/).
+(deeplearning4j-nn datasets/ + deeplearning4j-core datasets/iterator/impl/)
+plus the AsyncDataSetIterator/DataVec ETL-thread throughput machinery
+(MultiLayerNetwork.java:1023-1025), re-shaped for a device with a host
+link worth hiding: see data/prefetch.py.
 """
 
 from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet
@@ -13,6 +17,11 @@ from deeplearning4j_tpu.data.iterators import (
     ListDataSetIterator,
     MultipleEpochsIterator,
 )
+from deeplearning4j_tpu.data.prefetch import (
+    DevicePrefetchIterator,
+    ParallelDataSetIterator,
+)
+from deeplearning4j_tpu.data.transforms import DeviceBatchTransform
 from deeplearning4j_tpu.data.fetchers import (
     CifarDataSetIterator,
     IrisDataSetIterator,

@@ -19,9 +19,67 @@ import numpy as np
 
 from deeplearning4j_tpu.data.dataset import DataSet
 
+# Every background thread the input pipeline spawns (async prefetch,
+# multi-worker ETL, device prefetch, streaming pump) carries this name
+# prefix so tests/conftest.py can assert none survive a fit — a leaked
+# producer blocked on a full queue is a bug, not background noise.
+PIPELINE_THREAD_PREFIX = "dl4j-pipeline"
+
+# how often a blocked pipeline thread wakes to re-check its stop flag
+_POLL_SECONDS = 0.05
+
+
+def _put_abortable(q: "queue.Queue", item, stop: threading.Event) -> bool:
+    """Bounded put that can be cancelled: never blocks longer than
+    _POLL_SECONDS without re-checking `stop`. Returns False when the run
+    was aborted (the consumer went away) — the producer must exit, not
+    keep filling a queue nobody drains. This is the fix for the classic
+    prefetch-thread leak: a consumer that breaks mid-epoch used to leave
+    the producer blocked on `q.put` forever."""
+    while not stop.is_set():
+        try:
+            q.put(item, timeout=_POLL_SECONDS)
+            return True
+        except queue.Full:
+            continue
+    return False
+
+
+def _get_abortable(q: "queue.Queue", stop: threading.Event):
+    """Consumer counterpart of `_put_abortable`: blocks for the next item
+    but re-checks `stop` while the queue is empty, so a `close()` issued
+    from another thread ends iteration instead of leaving the consumer
+    blocked in `q.get()` forever (the producer cannot deliver its
+    end-of-stream sentinel once stop is set). Returns None on abort."""
+    while True:
+        try:
+            return q.get(timeout=_POLL_SECONDS)
+        except queue.Empty:
+            if stop.is_set():
+                return None
+
+
+def _close_run(q: "queue.Queue", stop: threading.Event,
+               threads: List[threading.Thread], timeout: float = 5.0):
+    """Tear down one epoch's pipeline machinery: signal stop, drain the
+    queue so producers blocked in put() wake immediately instead of at
+    the next poll, then join. Idempotent."""
+    stop.set()
+    try:
+        while True:
+            q.get_nowait()
+    except queue.Empty:
+        pass
+    for t in threads:
+        t.join(timeout=timeout)
+
 
 class DataSetIterator:
-    """SPI: iterable over DataSet minibatches with reset()."""
+    """SPI: iterable over DataSet minibatches with reset().
+
+    Iterators that own background workers override `close()` (and get
+    `with` support for free); for plain host iterators both are no-ops,
+    so callers can close any DataSetIterator unconditionally."""
 
     def __iter__(self) -> Iterator[DataSet]:
         raise NotImplementedError
@@ -34,6 +92,17 @@ class DataSetIterator:
 
     def total_examples(self) -> Optional[int]:
         return None
+
+    def close(self) -> None:
+        """Release background workers/queues, if any. Safe to call more
+        than once and on iterators that have none."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
 
 
 class ListDataSetIterator(DataSetIterator):
@@ -160,34 +229,58 @@ class AsyncDataSetIterator(DataSetIterator):
     """Background-thread prefetch with a bounded queue (reference:
     AsyncDataSetIterator, queue capacity = prefetch buffer). The worker
     thread performs ETL while the accelerator computes; exceptions propagate
-    to the consumer."""
+    to the consumer.
+
+    Shutdown contract: breaking out of iteration mid-epoch (or an
+    exception unwinding the consumer) closes the epoch's worker — the
+    generator's `finally` signals stop, drains the queue, and joins the
+    thread, so no producer is ever left blocked on a full queue. An
+    explicit `close()` (or `with` block) tears down any still-live
+    epochs; tests/conftest.py's thread-leak guard enforces this for every
+    pipeline stage."""
 
     def __init__(self, base: DataSetIterator, queue_size: int = 4):
         self.base = base
         self.queue_size = max(1, queue_size)
+        self._active: List[tuple] = []
 
     def __iter__(self):
         q: "queue.Queue" = queue.Queue(maxsize=self.queue_size)
+        stop = threading.Event()
         err: List[BaseException] = []
 
         def worker():
             try:
                 for ds in self.base:
-                    q.put(ds)
+                    if not _put_abortable(q, ds, stop):
+                        return
             except BaseException as e:  # propagate to consumer
                 err.append(e)
             finally:
-                q.put(_SENTINEL)
+                _put_abortable(q, _SENTINEL, stop)
 
-        t = threading.Thread(target=worker, daemon=True)
+        t = threading.Thread(target=worker, daemon=True,
+                             name=f"{PIPELINE_THREAD_PREFIX}-async")
+        run = (q, stop, t)
+        self._active.append(run)
         t.start()
-        while True:
-            item = q.get()
-            if item is _SENTINEL:
-                if err:
-                    raise err[0]
-                return
-            yield item
+        try:
+            while True:
+                item = _get_abortable(q, stop)
+                if item is None or item is _SENTINEL:
+                    if err:
+                        raise err[0]
+                    return
+                yield item
+        finally:
+            _close_run(q, stop, [t])
+            if run in self._active:
+                self._active.remove(run)
+
+    def close(self):
+        for q, stop, t in list(self._active):
+            _close_run(q, stop, [t])
+        self._active.clear()
 
     def reset(self):
         self.base.reset()

@@ -1,0 +1,399 @@
+"""Staged, fully-overlapped input pipeline.
+
+Two stages that compose with data/iterators.AsyncDataSetIterator into the
+zero-stall feed the fit loop installs automatically (nn/netbase._run_fit
+with async_prefetch=True):
+
+  host ETL (N workers)  ->  device prefetch (1 worker)  ->  fit loop
+  ParallelDataSetIterator   DevicePrefetchIterator          _fit_epochs
+
+* `ParallelDataSetIterator` is the DataVec-thread-pool analog (reference:
+  AsyncDataSetIterator + DataVec ETL threads feeding the compute loop,
+  MultiLayerNetwork.java:1023-1025): N workers pull items from one shared
+  base iterator, run the heavy `transform` (record decode, normalization,
+  host augmentation), and push into a bounded queue with ordered (default)
+  or unordered reassembly.
+* `DevicePrefetchIterator` runs `jax.device_put` — committed to the target
+  device or to a `NamedSharding` — in a background thread `depth` batches
+  ahead, so host->device DMA overlaps the previous step's compute instead
+  of sitting on the dispatch critical path. A `placement` callable (e.g.
+  parallel.ParallelWrapper's per-device shard function) replaces the
+  default device_put; a `transform` (data/transforms.DeviceBatchTransform)
+  then runs on the already-device-resident batch. Batches come out marked
+  `_pipeline_staged`, which tells the fit loop not to re-apply either.
+
+Every stage reports batches/bytes/stall/depth series into the shared
+MetricsRegistry (`input_pipeline_*{stage=...}`), the same place the fit
+loop's `fit_data_wait_seconds` lands — a pipeline that still stalls is a
+number, not a hunch.
+
+Shutdown contract (shared with AsyncDataSetIterator): exhausting,
+breaking out of, or erroring out of an epoch closes that epoch's workers
+via the consumer generator's `finally`; `close()`/`with` tears down
+anything still live. The conftest thread-leak guard enforces it.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Callable, List, Optional
+
+from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet
+from deeplearning4j_tpu.data.iterators import (
+    PIPELINE_THREAD_PREFIX,
+    DataSetIterator,
+    _close_run,
+    _get_abortable,
+    _put_abortable,
+)
+from deeplearning4j_tpu.utils import metrics as _metrics
+
+_DONE = object()  # one per ETL worker: "this worker's stream is finished"
+
+
+def _stage_instruments(stage: str) -> dict:
+    """Per-stage pipeline instruments, resolved once per iterator — hot
+    paths touch cached children only (netbase._fit_obs discipline)."""
+    reg = _metrics.get_registry()
+    batches = reg.counter(
+        "input_pipeline_batches_total",
+        "batches emitted by each input-pipeline stage", ("stage",))
+    nbytes = reg.counter(
+        "input_pipeline_bytes_total",
+        "host bytes emitted by each input-pipeline stage", ("stage",))
+    stall = reg.histogram(
+        "input_pipeline_stall_seconds",
+        "time an input-pipeline stage spent blocked on its queue "
+        "(producer: queue full; consumer: queue empty)",
+        ("stage", "side"))
+    depth = reg.gauge(
+        "input_pipeline_depth",
+        "current fill of each input-pipeline stage's queue", ("stage",))
+    return {
+        "batches": batches.labels(stage),
+        "bytes": nbytes.labels(stage),
+        "producer_stall": stall.labels(stage, "producer"),
+        "consumer_stall": stall.labels(stage, "consumer"),
+        "depth": depth.labels(stage),
+    }
+
+
+def _ds_nbytes(ds) -> int:
+    """Byte accounting for the stage metrics. Total by design — it runs
+    on the worker's post-delivery path, where an exception would kill the
+    worker silently; arbitrary non-DataSet ETL items count as 0."""
+    if isinstance(ds, MultiDataSet):
+        arrays = list(ds.features) + list(ds.labels) \
+            + list(ds.features_masks or []) + list(ds.labels_masks or [])
+    elif isinstance(ds, DataSet):
+        arrays = [ds.features, ds.labels, ds.features_mask, ds.labels_mask]
+    else:
+        return 0
+    return sum(int(getattr(a, "nbytes", 0)) for a in arrays if a is not None)
+
+
+def _carry_metadata(src, dst):
+    """Propagate the bookkeeping attributes a placement/transform must
+    not drop: pad-aware example counts (ParallelWrapper._shard_batch's
+    `reported_examples`) and the staged marker. Every stage that rebuilds
+    a DataSet routes through here (transforms.py included) so new
+    metadata has one place to live."""
+    n = getattr(src, "reported_examples", None)
+    if n is not None:
+        dst.reported_examples = n
+    if getattr(src, "_pipeline_staged", False):
+        dst._pipeline_staged = True
+    return dst
+
+
+def place_dataset(ds, target):
+    """`jax.device_put` every array of a DataSet/MultiDataSet onto
+    `target` (a Device or a Sharding) — the default placement stage. A
+    batch that already lives there comes back buffer-shared, so
+    re-staging pre-placed data is free."""
+    import jax
+
+    put = lambda a: None if a is None else jax.device_put(a, target)
+    if isinstance(ds, MultiDataSet):
+        out = MultiDataSet(
+            [put(f) for f in ds.features],
+            [put(l) for l in ds.labels],
+            None if ds.features_masks is None
+            else [put(m) for m in ds.features_masks],
+            None if ds.labels_masks is None
+            else [put(m) for m in ds.labels_masks],
+        )
+    else:
+        out = DataSet(put(ds.features), put(ds.labels),
+                      put(ds.features_mask), put(ds.labels_mask))
+    return _carry_metadata(ds, out)
+
+
+class ParallelDataSetIterator(DataSetIterator):
+    """Multi-worker ETL over one splittable base iterator.
+
+    `base` yields work items — already-built DataSets, or raw records
+    (paths, encoded rows) that `transform` turns into DataSets. Workers
+    share the base through a lock (the pull is cheap; `transform` is the
+    expensive part and runs unlocked in parallel), push into a bounded
+    queue, and the consumer reassembles:
+
+    * ordered=True (default): batches come out in base order — a reorder
+      buffer holds early arrivals, so training curves are independent of
+      worker scheduling. An item whose transform raised surfaces at its
+      position, after every earlier batch was consumed.
+    * ordered=False: completion order, minimum latency.
+
+    Exceptions propagate to the consumer; end-of-stream is reached when
+    every worker has drained the base. Shutdown follows the module
+    contract (close-on-break, `close()`, `with`).
+    """
+
+    def __init__(self, base, transform: Optional[Callable] = None,
+                 workers: int = 2, queue_size: Optional[int] = None,
+                 ordered: bool = True, stage: str = "etl"):
+        self.base = base
+        self.transform = transform
+        self.workers = max(1, int(workers))
+        self.queue_size = max(self.workers, int(queue_size)
+                              if queue_size is not None else 2 * self.workers)
+        self.ordered = ordered
+        self._ins = _stage_instruments(stage)
+        self._active: List[tuple] = []
+
+    def __iter__(self):
+        src = iter(self.base)
+        src_lock = threading.Lock()
+        seq_box = [0]
+        q: "queue.Queue" = queue.Queue(maxsize=self.queue_size)
+        stop = threading.Event()
+        ins = self._ins
+
+        def worker():
+            while not stop.is_set():
+                seq = None
+                try:
+                    with src_lock:
+                        try:
+                            item = next(src)
+                        except StopIteration:
+                            return
+                        seq = seq_box[0]
+                        seq_box[0] += 1
+                    out = self.transform(item) if self.transform else item
+                except BaseException as e:
+                    # seq None: the BASE iterator raised — deliver
+                    # immediately (every worker will hit it; first wins)
+                    _put_abortable(q, (-1 if seq is None else seq, e, None),
+                                   stop)
+                    return
+                t0 = time.perf_counter()
+                if not _put_abortable(q, (seq, None, out), stop):
+                    return
+                ins["producer_stall"].observe(time.perf_counter() - t0)
+                ins["batches"].inc()
+                ins["bytes"].inc(_ds_nbytes(out))
+
+        def worker_main():
+            try:
+                worker()
+            finally:
+                # the _DONE marker must go out even on an unexpected
+                # failure — a missing marker would hang the consumer
+                _put_abortable(q, _DONE, stop)
+
+        threads = []
+        for i in range(self.workers):
+            t = threading.Thread(
+                target=worker_main, daemon=True,
+                name=f"{PIPELINE_THREAD_PREFIX}-etl-{i}")
+            threads.append(t)
+        run = (q, stop, threads)
+        self._active.append(run)
+        ins["depth"].set_function(q.qsize)
+        for t in threads:
+            t.start()
+        try:
+            yield from self._reassemble(q, stop, ins)
+        finally:
+            _close_run(q, stop, threads)
+            if run in self._active:
+                self._active.remove(run)
+
+    def _reassemble(self, q, stop, ins):
+        done, buf, nxt = 0, {}, 0
+        while done < self.workers:
+            t0 = time.perf_counter()
+            item = _get_abortable(q, stop)
+            ins["consumer_stall"].observe(time.perf_counter() - t0)
+            if item is None:  # aborted by an external close()
+                return
+            if item is _DONE:
+                done += 1
+                continue
+            seq, err, out = item
+            if not self.ordered:
+                if err is not None:
+                    raise err
+                yield out
+                continue
+            if seq < 0:  # base-iterator failure: position unknowable
+                raise err
+            buf[seq] = (err, out)
+            while nxt in buf:
+                e, o = buf.pop(nxt)
+                nxt += 1
+                if e is not None:
+                    raise e
+                yield o
+        # every worker put its items before its _DONE marker (per-producer
+        # FIFO), so whatever remains buffered is complete — flush in order
+        for seq in sorted(buf):
+            e, o = buf[seq]
+            if e is not None:
+                raise e
+            yield o
+
+    def close(self):
+        for q, stop, threads in list(self._active):
+            _close_run(q, stop, threads)
+        self._active.clear()
+
+    def reset(self):
+        self.close()
+        if hasattr(self.base, "reset"):
+            self.base.reset()
+
+    def batch_size(self):
+        bs = getattr(self.base, "batch_size", None)
+        return bs() if callable(bs) else None
+
+    def total_examples(self):
+        te = getattr(self.base, "total_examples", None)
+        return te() if callable(te) else None
+
+
+class DevicePrefetchIterator(DataSetIterator):
+    """Device-resident double-buffered prefetch: a background thread
+    stages each host batch onto the accelerator `depth` batches ahead of
+    the fit loop, so host->device DMA (and, under ParallelWrapper, the
+    per-device shard split) overlaps the previous step's compute.
+
+    placement:
+      * None — `jax.device_put` committed to `device` (default: the
+        process default device) or to a NamedSharding passed as `device`.
+      * a callable ds->ds — a custom staging function; ParallelWrapper
+        installs its `_shard_batch` here, which is how sharding leaves
+        the dispatch critical path.
+    transform: an optional on-device batch transform (ds->ds, e.g.
+      data/transforms.DeviceBatchTransform) applied AFTER placement — the
+      per-pixel work runs as a jitted program on the accelerator, not in
+      host numpy.
+
+    Emitted batches carry `_pipeline_staged=True`: nn/netbase's fit loop
+    skips its own `_batch_transform`/input-transform application for
+    them, so a pre-placed batch is never transferred (or augmented)
+    twice. Device memory bound: `depth + 1` staged batches in flight.
+    """
+
+    def __init__(self, base: DataSetIterator, depth: int = 2,
+                 placement=None, device=None,
+                 transform: Optional[Callable] = None,
+                 close_base: bool = False,
+                 stage: str = "device_prefetch"):
+        self.base = base
+        self.depth = max(1, int(depth))
+        self.placement = placement
+        self.device = device
+        self.transform = transform
+        self.close_base = close_base
+        self._ins = _stage_instruments(stage)
+        self._active: List[tuple] = []
+        self._sentinel = object()
+
+    def _resolve_target(self):
+        """Default staging target, resolved on the CONSUMER thread at
+        epoch start (not in the worker): `jax.default_device` is a
+        thread-local config override, so only the fit thread sees the
+        user's `with jax.default_device(d):` scope."""
+        if callable(self.placement) or self.device is not None:
+            return self.device
+        import jax
+
+        return (getattr(jax.config, "jax_default_device", None)
+                or jax.devices()[0])
+
+    def _stage(self, ds, target):
+        if getattr(ds, "_pipeline_staged", False):
+            return ds  # already staged upstream (e.g. a nested pipeline)
+        if callable(self.placement):
+            out = _carry_metadata(ds, self.placement(ds))
+        else:
+            out = place_dataset(ds, target)
+        if self.transform is not None:
+            out = _carry_metadata(out, self.transform(out))
+        out._pipeline_staged = True
+        return out
+
+    def __iter__(self):
+        q: "queue.Queue" = queue.Queue(maxsize=self.depth)
+        stop = threading.Event()
+        err: List[BaseException] = []
+        ins = self._ins
+        sentinel = self._sentinel
+        target = self._resolve_target()
+
+        def worker():
+            try:
+                for ds in self.base:
+                    nb = _ds_nbytes(ds)  # host bytes, before staging
+                    staged = self._stage(ds, target)
+                    t0 = time.perf_counter()
+                    if not _put_abortable(q, staged, stop):
+                        return
+                    ins["producer_stall"].observe(time.perf_counter() - t0)
+                    ins["batches"].inc()
+                    ins["bytes"].inc(nb)
+            except BaseException as e:
+                err.append(e)
+            finally:
+                _put_abortable(q, sentinel, stop)
+
+        t = threading.Thread(target=worker, daemon=True,
+                             name=f"{PIPELINE_THREAD_PREFIX}-device-prefetch")
+        run = (q, stop, [t])
+        self._active.append(run)
+        ins["depth"].set_function(q.qsize)
+        t.start()
+        try:
+            while True:
+                t0 = time.perf_counter()
+                item = _get_abortable(q, stop)
+                ins["consumer_stall"].observe(time.perf_counter() - t0)
+                if item is None or item is sentinel:
+                    if err:
+                        raise err[0]
+                    return
+                yield item
+        finally:
+            _close_run(q, stop, [t])
+            if run in self._active:
+                self._active.remove(run)
+
+    def close(self):
+        for q, stop, threads in list(self._active):
+            _close_run(q, stop, threads)
+        self._active.clear()
+        if self.close_base:
+            self.base.close()
+
+    def reset(self):
+        self.base.reset()
+
+    def batch_size(self):
+        return self.base.batch_size()
+
+    def total_examples(self):
+        return self.base.total_examples()

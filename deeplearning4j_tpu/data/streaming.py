@@ -17,7 +17,13 @@ from typing import Callable, Iterable, Optional, Tuple, Union
 import numpy as np
 
 from deeplearning4j_tpu.data.dataset import DataSet
-from deeplearning4j_tpu.data.iterators import DataSetIterator
+from deeplearning4j_tpu.data.iterators import (
+    PIPELINE_THREAD_PREFIX,
+    DataSetIterator,
+    _close_run,
+    _get_abortable,
+    _put_abortable,
+)
 
 _SENTINEL = object()
 
@@ -39,6 +45,7 @@ class StreamingDataSetIterator(DataSetIterator):
         self._q: Optional[queue.Queue] = None
         self._thread: Optional[threading.Thread] = None
         self._error: Optional[BaseException] = None
+        self._stop: Optional[threading.Event] = None
 
     def reset(self):
         """No-op: the fit loop resets after each epoch, which is legal at
@@ -56,33 +63,47 @@ class StreamingDataSetIterator(DataSetIterator):
     def _pump(self):
         try:
             if callable(self.source):
-                while True:
+                while not self._stop.is_set():
                     item = self.source()
                     if item is None:
                         break
-                    self._q.put(item)
+                    if not _put_abortable(self._q, item, self._stop):
+                        return
             else:
                 for item in self.source:
-                    self._q.put(item)
+                    if not _put_abortable(self._q, item, self._stop):
+                        return
         except BaseException as e:  # surface in the consumer
             self._error = e
         finally:
-            self._q.put(_SENTINEL)
+            _put_abortable(self._q, _SENTINEL, self._stop)
 
     def __iter__(self):
         self._consumed_guard()
         self._q = queue.Queue(maxsize=self.buffer_size)
         self._error = None
-        self._thread = threading.Thread(target=self._pump, daemon=True)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._pump, daemon=True,
+            name=f"{PIPELINE_THREAD_PREFIX}-stream")
         self._thread.start()
-        while True:
-            item = self._q.get()
-            if item is _SENTINEL:
-                if self._error is not None:
-                    raise self._error
-                return
-            if isinstance(item, DataSet):
-                yield item
-            else:
-                x, y = item
-                yield DataSet(np.asarray(x), np.asarray(y))
+        try:
+            while True:
+                item = _get_abortable(self._q, self._stop)
+                if item is None or item is _SENTINEL:
+                    if self._error is not None:
+                        raise self._error
+                    return
+                if isinstance(item, DataSet):
+                    yield item
+                else:
+                    x, y = item
+                    yield DataSet(np.asarray(x), np.asarray(y))
+        finally:
+            # close-on-break: a consumer that stops mid-stream must not
+            # leave the pump blocked on a full buffer forever
+            _close_run(self._q, self._stop, [self._thread])
+
+    def close(self):
+        if self._thread is not None and self._stop is not None:
+            _close_run(self._q, self._stop, [self._thread])

@@ -1,0 +1,143 @@
+"""On-device batch transforms — the heavy per-pixel ETL tail, jitted.
+
+The DataVec analog kept normalization and augmentation in host code
+(ImagePreProcessingScaler, transform pipelines run by the ETL threads);
+here the same math runs as ONE jitted program on the already-staged
+device batch, composed by DevicePrefetchIterator after placement — so the
+accelerator does the per-pixel work and host numpy never touches it.
+
+`DeviceBatchTransform` is shape-keyed: one compile per distinct
+(features shape, dtype), counted under `compile_total{kind=
+"input_transform"}`. Randomness is deterministic: a per-transform step
+counter is folded into the seed key (`fold_in(key, step)`), and the step
+rides into the jitted function as a traced scalar — step 7 augments the
+same way whether the pipeline is on, off, or replayed, which is what
+makes prefetch-on vs prefetch-off training byte-identical when no
+augmentation is configured and bit-reproducible across runs when it is.
+
+Augmentation layout contract: flip/crop require NHWC image batches
+(ndim == 4); `normalize` works on any feature layout.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet
+from deeplearning4j_tpu.utils import metrics as _metrics
+
+
+class DeviceBatchTransform:
+    """Jitted feature-batch transform: normalize -> random flip ->
+    random crop, any subset.
+
+    Args:
+        normalize: (mean, std) — arrays/scalars broadcastable against the
+            feature batch; computes (x - mean) / std.
+        random_flip: horizontal flip with p=0.5 per example (NHWC).
+        random_crop: pad each spatial edge by `random_crop` pixels
+            (zeros), then take a per-example random HxW crop back to the
+            original size — the standard CIFAR-style augmentation.
+        seed: RNG seed; per-batch keys derive via fold_in(key, step).
+    """
+
+    def __init__(self, normalize: Optional[Tuple] = None,
+                 random_flip: bool = False,
+                 random_crop: Optional[int] = None, seed: int = 0):
+        self.normalize = normalize
+        self.random_flip = bool(random_flip)
+        self.random_crop = None if not random_crop else int(random_crop)
+        self.seed = int(seed)
+        self._fns: dict = {}
+        self._lock = threading.Lock()
+        self._step = 0
+
+    @property
+    def randomized(self) -> bool:
+        return self.random_flip or self.random_crop is not None
+
+    def _build(self, shape, dtype):
+        import jax
+        import jax.numpy as jnp
+
+        if self.randomized and len(shape) != 4:
+            raise ValueError(
+                f"random flip/crop need NHWC image batches, got shape "
+                f"{shape}; use normalize-only for non-image features")
+        mean = std = None
+        if self.normalize is not None:
+            m, s = self.normalize
+            mean = jnp.asarray(np.asarray(m, np.float32))
+            std = jnp.asarray(np.asarray(s, np.float32))
+        pad = self.random_crop
+
+        def fn(x, step):
+            if mean is not None:
+                x = (x - mean.astype(x.dtype)) / std.astype(x.dtype)
+            if not self.randomized:
+                return x
+            key = jax.random.fold_in(jax.random.PRNGKey(self.seed), step)
+            n, h, w = x.shape[0], x.shape[1], x.shape[2]
+            if self.random_flip:
+                key, k = jax.random.split(key)
+                flip = jax.random.bernoulli(k, 0.5, (n,))
+                x = jnp.where(flip[:, None, None, None], x[:, :, ::-1, :], x)
+            if pad is not None:
+                key, k = jax.random.split(key)
+                xp = jnp.pad(x, ((0, 0), (pad, pad), (pad, pad), (0, 0)))
+                offs = jax.random.randint(k, (n, 2), 0, 2 * pad + 1)
+
+                def crop_one(img, off):
+                    return jax.lax.dynamic_slice(
+                        img, (off[0], off[1], 0), (h, w, x.shape[3]))
+
+                x = jax.vmap(crop_one)(xp, offs)
+            return x
+
+        return jax.jit(fn)
+
+    def _fn_for(self, x):
+        key = (tuple(x.shape), str(getattr(x, "dtype", None)))
+        with self._lock:
+            fn = self._fns.get(key)
+            if fn is None:
+                fn = self._fns[key] = self._build(x.shape, x.dtype)
+                _metrics.get_registry().counter(
+                    "compile_total", "jit cache insertions (fresh traces)",
+                    ("kind",)).labels("input_transform").inc()
+        return fn
+
+    def __call__(self, ds):
+        """Transform a DataSet/MultiDataSet's features (labels and masks
+        pass through). One step value per call, shared by every features
+        array of a MultiDataSet — deterministic regardless of pipeline
+        staging."""
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.data.prefetch import _carry_metadata
+
+        with self._lock:
+            step = self._step
+            self._step += 1
+        step_arr = jnp.asarray(step, jnp.uint32)
+        apply = lambda x: self._fn_for(x)(x, step_arr)
+        if isinstance(ds, MultiDataSet):
+            out = MultiDataSet([apply(f) for f in ds.features], ds.labels,
+                               ds.features_masks, ds.labels_masks)
+        else:
+            out = DataSet(apply(ds.features), ds.labels,
+                          ds.features_mask, ds.labels_mask)
+        return _carry_metadata(ds, out)
+
+    def reset_steps(self):
+        """Rewind the per-batch step counter (replaying an identical run)."""
+        with self._lock:
+            self._step = 0
+
+    @property
+    def compile_count(self) -> int:
+        with self._lock:
+            return len(self._fns)

@@ -419,10 +419,12 @@ class ComputationGraph(NetworkBase):
     # -- fit -----------------------------------------------------------------
 
     def fit(self, data, labels=None, *, epochs: int = 1, batch_size: int = 32,
-            async_prefetch: bool = True):
+            async_prefetch: bool = True, prefetch_buffer: int = 4):
         """Train. Accepts (features, labels) arrays, a DataSet/MultiDataSet,
         or a DataSetIterator/MultiDataSetIterator (reference:
-        ComputationGraph.fit overloads :857-867)."""
+        ComputationGraph.fit overloads :857-867). With async_prefetch the
+        staged input pipeline (nn/netbase._stage_input_pipeline) feeds the
+        loop; prefetch_buffer is the host stage's queue depth."""
         self._require_init()
         if isinstance(data, (DataSetIterator, MultiDataSetIterator)):
             iterator = data
@@ -434,7 +436,8 @@ class ComputationGraph(NetworkBase):
             iterator = ListDataSetIterator(
                 DataSet(np.asarray(data), np.asarray(labels)), batch_size
             )
-        return self._run_fit(iterator, epochs, async_prefetch)
+        return self._run_fit(iterator, epochs, async_prefetch,
+                             prefetch_buffer)
 
     def _fit_dataset(self, ds):
         mds = _as_multidataset(ds)

@@ -608,18 +608,22 @@ class MultiLayerNetwork(NetworkBase):
     # -- fit -----------------------------------------------------------------
 
     def fit(self, data, labels=None, *, epochs: int = 1, batch_size: int = 32,
-            async_prefetch: bool = True):
+            async_prefetch: bool = True, prefetch_buffer: int = 4):
         """Train. Accepts (features, labels) arrays, a DataSet, or a
         DataSetIterator (reference: MultiLayerNetwork.fit overloads
         :1019). If the configuration sets pretrain=True, layerwise
         unsupervised pretraining runs once before the first backprop epoch
-        (reference: fit() pretrain dispatch :210)."""
+        (reference: fit() pretrain dispatch :210). With async_prefetch the
+        staged input pipeline (host ETL thread -> device prefetch, see
+        nn/netbase._stage_input_pipeline) feeds the loop; prefetch_buffer
+        is the host stage's queue depth."""
         self._require_init()
         if self.conf.pretrain and not getattr(self, "_pretrained", False):
             self.pretrain(data, batch_size=batch_size)
             self._pretrained = True
         iterator = self._as_iterator(data, labels, batch_size)
-        return self._run_fit(iterator, epochs, async_prefetch)
+        return self._run_fit(iterator, epochs, async_prefetch,
+                             prefetch_buffer)
 
     def _as_iterator(self, data, labels, batch_size) -> DataSetIterator:
         if isinstance(data, DataSetIterator):

@@ -47,8 +47,17 @@ class NetworkBase:
         self._collect_stats = False
         self._last_stats = None
         # hook applied to each DataSet before the step — installed by
-        # parallel.ParallelWrapper to shard the batch across the mesh
+        # parallel.ParallelWrapper to shard the batch across the mesh.
+        # Under async_prefetch it runs inside the device-prefetch worker
+        # thread (off the dispatch critical path); staged batches carry
+        # `_pipeline_staged` so the loop never applies it twice
         self._batch_transform = None
+        # on-device batch transform (data/transforms.DeviceBatchTransform)
+        # applied after placement — set_input_transform
+        self._input_transform = None
+        # device-prefetch queue depth (staged batches held ahead of the
+        # step; device memory bound = depth + 1 batches)
+        self._prefetch_depth = 2
         # fuse K consecutive same-shape minibatches into ONE jitted
         # dispatch (set_fused_steps) — the dispatch-latency amortizer
         self._fused_k = 1
@@ -154,6 +163,21 @@ class NetworkBase:
         self._fused_k = max(1, int(k))
         return self
 
+    def set_input_transform(self, transform):
+        """Install an on-device batch transform (e.g.
+        data.transforms.DeviceBatchTransform): under async_prefetch it
+        runs jitted on the staged device batch inside the prefetch
+        worker; with prefetch off it runs inline before the step — same
+        math, same per-batch rng step, either way. Pass None to remove."""
+        self._input_transform = transform
+        return self
+
+    def set_prefetch_depth(self, depth: int):
+        """How many device-staged batches the input pipeline holds ahead
+        of the train step (see data.prefetch.DevicePrefetchIterator)."""
+        self._prefetch_depth = max(1, int(depth))
+        return self
+
     def _fused_fit_supported(self) -> bool:
         """Whether this network can run `_fit_datasets_fused`."""
         return False
@@ -233,6 +257,11 @@ class NetworkBase:
                     "device sync to the step's score — measured only "
                     "while tracing is enabled, so the default fit path "
                     "never adds blocking syncs").labels(),
+                "examples_unknown": reg.counter(
+                    "fit_examples_unknown_total",
+                    "fit batches whose example count could not be "
+                    "determined (excluded from fit_examples_total — "
+                    "an under-report made explicit, not silent)").labels(),
             }
         return ins
 
@@ -262,20 +291,29 @@ class NetworkBase:
         ins["data_wait"].observe(data_wait)
         ins["dispatch"].observe(dispatch)
 
-    @staticmethod
-    def _ds_examples(ds) -> int:
+    def _ds_examples(self, ds) -> int:
+        """Example count for `fit_examples_total`. Only structural
+        can't-know failures (no such method/attribute, malformed shape)
+        degrade to 0 — and those are counted under
+        `fit_examples_unknown_total` so the under-report is visible. A
+        real iterator bug raising anything else propagates; the old bare
+        `except Exception` swallowed those."""
         try:
             return int(getattr(ds, "reported_examples", None)
                        or ds.num_examples())
-        except Exception:
+        except (AttributeError, TypeError, IndexError):
+            self._fit_obs()["examples_unknown"].inc()
             return 0
 
     # -- the fit loop --------------------------------------------------------
 
     def _run_fit(self, iterator, epochs: int, async_prefetch: bool,
                  prefetch_buffer: int = 4):
-        if async_prefetch and not isinstance(iterator, AsyncDataSetIterator):
-            iterator = AsyncDataSetIterator(iterator, prefetch_buffer)
+        owned = None
+        if async_prefetch:
+            staged = self._stage_input_pipeline(iterator, prefetch_buffer)
+            if staged is not iterator:
+                iterator = owned = staged
         fuse_k = self._fused_k if (
             self._fused_k > 1
             and not self.listeners
@@ -286,6 +324,11 @@ class NetworkBase:
         try:
             self._fit_epochs(iterator, epochs, fuse_k)
         finally:
+            # pipeline workers this fit created must die with it, raise
+            # or return (the generators' own finally handles the common
+            # case; this covers anything still live after an exception)
+            if owned is not None:
+                owned.close()
             # fires even when an epoch raises: listeners that flipped
             # process-global state for the run (TracingListener) restore
             # it here instead of leaking it past a failed fit
@@ -294,6 +337,62 @@ class NetworkBase:
                 if hook is not None:
                     hook(self)
         return self
+
+    def _stage_input_pipeline(self, iterator, prefetch_buffer: int):
+        """Compose the staged input pipeline around a fit's iterator:
+
+            [caller's host ETL] -> AsyncDataSetIterator -> device prefetch
+
+        * If the caller already built a DevicePrefetchIterator, it IS the
+          pipeline — used as-is (bench/resnet pass pre-staged batches).
+        * A caller-provided host stage (AsyncDataSetIterator or
+          ParallelDataSetIterator multi-worker ETL) is kept; otherwise a
+          single async host-prefetch thread is added (the pre-pipeline
+          behavior).
+        * The device stage runs `_batch_transform` (ParallelWrapper's
+          per-device sharding) — or a committed default-device
+          `device_put` — plus the on-device input transform, all in its
+          worker thread, `_prefetch_depth` batches ahead: host->device
+          transfer leaves the dispatch critical path.
+        """
+        from deeplearning4j_tpu.data.prefetch import (
+            DevicePrefetchIterator,
+            ParallelDataSetIterator,
+        )
+
+        if isinstance(iterator, DevicePrefetchIterator):
+            # caller-built pipeline: it must carry the net's configured
+            # staging, or the loop would silently train unsharded /
+            # untransformed (staged batches skip the inline application)
+            for mine, theirs, what in (
+                (self._batch_transform, iterator.placement,
+                 "batch transform (ParallelWrapper sharding)"),
+                (self._input_transform, iterator.transform,
+                 "input transform"),
+            ):
+                # `!=`, not `is not`: bound methods (ParallelWrapper's
+                # _shard_batch) are fresh objects per attribute access
+                # but compare equal on (__self__, __func__)
+                if mine is not None and theirs != mine:
+                    raise ValueError(
+                        f"a DevicePrefetchIterator was passed to fit() but "
+                        f"the network has a {what} configured that the "
+                        f"iterator does not apply — build the iterator "
+                        f"with it (placement=/transform=), or pass the "
+                        f"un-staged base iterator and let fit compose "
+                        f"the pipeline")
+            return iterator
+        host = iterator
+        wrapped = False
+        if not isinstance(host, (AsyncDataSetIterator,
+                                 ParallelDataSetIterator)):
+            host = AsyncDataSetIterator(host, prefetch_buffer)
+            wrapped = True
+        return DevicePrefetchIterator(
+            host, depth=self._prefetch_depth,
+            placement=self._batch_transform,
+            transform=self._input_transform,
+            close_base=wrapped)
 
     def _fit_epochs(self, iterator, epochs: int, fuse_k: int):
         for _ in range(epochs):
@@ -309,8 +408,13 @@ class NetworkBase:
             for ds in iterator:
                 wait = time.perf_counter() - t_etl
                 self._last_etl_ms = wait * 1e3
-                if self._batch_transform is not None:
-                    ds = self._batch_transform(ds)
+                if not getattr(ds, "_pipeline_staged", False):
+                    # prefetch-off path: staging work runs inline (same
+                    # ops, same order — byte-identical to the pipeline)
+                    if self._batch_transform is not None:
+                        ds = self._batch_transform(ds)
+                    if self._input_transform is not None:
+                        ds = self._input_transform(ds)
                 if fuse_k > 1:
                     s = self._ds_signature(ds)
                     if buf and s != sig:

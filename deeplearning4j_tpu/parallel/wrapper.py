@@ -170,7 +170,13 @@ class ParallelWrapper:
         """Train data-parallel. Accepts the same inputs as
         MultiLayerNetwork.fit; `batch_size` is the GLOBAL batch (sharded
         across devices). With workers > 1 and an iterator input, each step
-        consumes `workers` minibatches as one global batch."""
+        consumes `workers` minibatches as one global batch.
+
+        With async_prefetch, `_shard_batch` (pad + per-device
+        `device_put`) runs inside the device-prefetch worker thread
+        `prefetch_buffer`-deep ahead of the step (netbase's staged input
+        pipeline), so the shard split overlaps the previous step's
+        compute instead of sitting on the dispatch critical path."""
         net = self.model
         data_in = data
         if self.workers > 1:
@@ -184,7 +190,8 @@ class ParallelWrapper:
         net._batch_transform = self._shard_batch
         try:
             net.fit(data_in, labels, epochs=epochs, batch_size=batch_size,
-                    async_prefetch=async_prefetch)
+                    async_prefetch=async_prefetch,
+                    prefetch_buffer=self.prefetch_buffer)
         finally:
             net._batch_transform = prev_transform
         return net

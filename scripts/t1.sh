@@ -71,5 +71,8 @@ fi
 if [ -n "${T1_METRICS_DUMP:-}" ]; then
     echo "T1 metrics snapshot: ${T1_METRICS_ARTIFACT:-/tmp/_t1_metrics.json}"
 fi
+# surface the conftest thread-leak guard's session verdict (each leak also
+# failed its test above — this is the at-a-glance summary)
+grep -a '^T1 THREAD GUARD:' /tmp/_t1.log || echo "T1 THREAD GUARD: no verdict line (session died early?)"
 echo "T1 OK: $(wc -l < "$artifact" | tr -d ' ') failing (all within the $(wc -l < "$baseline" | tr -d ' ')-name baseline); artifact: $artifact"
 exit 0

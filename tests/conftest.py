@@ -36,7 +36,68 @@ def pytest_configure(config):
         "markers", "slow: long-running tests (multi-process, large fits)")
 
 
+# -- input-pipeline thread-leak guard -----------------------------------------
+# Every background thread the data pipeline spawns carries the
+# PIPELINE_THREAD_PREFIX name. After each test, none may survive: a live
+# one is a producer left blocked on a queue nobody drains (exactly the
+# AsyncDataSetIterator break-mid-epoch leak this guard was added to
+# catch). The grace window lets a worker that is already past its last
+# put finish dying.
+
+import weakref  # noqa: E402
+
+_PIPELINE_LEAKS = []
+# thread OBJECTS already charged to a test (idents get recycled, objects
+# don't); weak so a reported thread that finally dies can be collected
+_REPORTED_LEAKED_THREADS = weakref.WeakSet()
+
+
+def _live_pipeline_threads():
+    import threading
+
+    from deeplearning4j_tpu.data.iterators import PIPELINE_THREAD_PREFIX
+
+    return sorted(((t, t.name) for t in threading.enumerate()
+                   if t.name.startswith(PIPELINE_THREAD_PREFIX)
+                   and t.is_alive()
+                   and t not in _REPORTED_LEAKED_THREADS),
+                  key=lambda pair: pair[1])
+
+
+@pytest.fixture(autouse=True)
+def _pipeline_thread_leak_guard(request):
+    yield
+    import time
+
+    deadline = time.monotonic() + 2.0
+    leaked = _live_pipeline_threads()
+    while leaked and time.monotonic() < deadline:
+        time.sleep(0.05)
+        leaked = _live_pipeline_threads()
+    if leaked:
+        # charge each leaked thread to the test that leaked it, once —
+        # without this, one leak would cascade failures across the rest
+        # of the session
+        _REPORTED_LEAKED_THREADS.update(t for t, _ in leaked)
+        names = [name for _, name in leaked]
+        _PIPELINE_LEAKS.append((request.node.nodeid, names))
+        pytest.fail(
+            f"leaked input-pipeline worker threads: {names} — a pipeline "
+            "stage was not closed (close-on-break contract, "
+            "data/iterators.py)", pytrace=False)
+
+
 def pytest_sessionfinish(session, exitstatus):
+    # One greppable line for scripts/t1.sh: the thread-leak guard's
+    # verdict for the whole session (each leak also failed its test).
+    if _PIPELINE_LEAKS:
+        print(f"\nT1 THREAD GUARD: {len(_PIPELINE_LEAKS)} test(s) leaked "
+              "pipeline worker threads:")
+        for nodeid, names in _PIPELINE_LEAKS:
+            print(f"T1 THREAD GUARD:   {nodeid}: {names}")
+    else:
+        print("\nT1 THREAD GUARD: ok (no leaked pipeline worker threads)")
+
     # Opt-in observability artifact (scripts/t1.sh T1_METRICS_DUMP=1):
     # dump the process-global metrics registry after the run so compile
     # counts / helper events can be diffed across PRs.

@@ -1,0 +1,478 @@
+"""Staged input pipeline: multi-worker ETL, device-resident prefetch,
+on-device transforms, iterator edge cases, and the shutdown contract
+(close-on-break — the AsyncDataSetIterator worker-leak regression).
+
+Equivalence pin: training with the pipeline on must be byte-identical to
+training with it off (same seeds, CPU) — staging moves WHERE work runs,
+never WHAT runs.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.data.iterators import (
+    PIPELINE_THREAD_PREFIX,
+    AsyncDataSetIterator,
+    ExistingDataSetIterator,
+    ListDataSetIterator,
+    MultipleEpochsIterator,
+    StackedDataSetIterator,
+)
+from deeplearning4j_tpu.data.prefetch import (
+    DevicePrefetchIterator,
+    ParallelDataSetIterator,
+)
+from deeplearning4j_tpu.data.transforms import DeviceBatchTransform
+
+
+def _live_pipeline_threads():
+    return [t for t in threading.enumerate()
+            if t.name.startswith(PIPELINE_THREAD_PREFIX) and t.is_alive()]
+
+
+def _assert_no_pipeline_threads(timeout=2.0):
+    deadline = time.monotonic() + timeout
+    while _live_pipeline_threads() and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert not _live_pipeline_threads(), [
+        t.name for t in _live_pipeline_threads()]
+
+
+def _toy_dataset(n=24, n_in=4, n_out=2, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, n_in)).astype(np.float32)
+    y = np.zeros((n, n_out), np.float32)
+    y[np.arange(n), rng.integers(0, n_out, n)] = 1.0
+    return DataSet(x, y)
+
+
+def _toy_net(n_in=4, n_out=2, seed=42):
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    conf = (NeuralNetConfiguration.builder().seed(seed).list()
+            .layer(DenseLayer(n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_out=n_out, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.feed_forward(n_in)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+# -- satellite 1: AsyncDataSetIterator close-on-break -------------------------
+
+
+def test_async_iterator_break_mid_epoch_stops_worker():
+    """Regression: breaking out of iteration used to leave the producer
+    thread blocked forever on the full queue (no shutdown signal)."""
+    ds = _toy_dataset(n=64)
+    it = AsyncDataSetIterator(ListDataSetIterator(ds, 2), queue_size=1)
+    for i, _ in enumerate(it):
+        if i == 1:
+            break  # queue is full, producer is blocked in put()
+    _assert_no_pipeline_threads()
+
+
+def test_async_iterator_context_manager_and_close():
+    ds = _toy_dataset(n=64)
+    with AsyncDataSetIterator(ListDataSetIterator(ds, 2), queue_size=1) as it:
+        gen = iter(it)
+        next(gen)
+        it.close()  # explicit close with the epoch still live
+    _assert_no_pipeline_threads()
+
+
+def test_async_iterator_consumer_exception_stops_worker():
+    ds = _toy_dataset(n=64)
+    it = AsyncDataSetIterator(ListDataSetIterator(ds, 2), queue_size=1)
+    with pytest.raises(RuntimeError, match="consumer died"):
+        for _ in it:
+            raise RuntimeError("consumer died")
+    _assert_no_pipeline_threads()
+
+
+def test_async_iterator_full_epoch_and_producer_error():
+    ds = _toy_dataset(n=12)
+    assert len(list(AsyncDataSetIterator(ListDataSetIterator(ds, 3)))) == 4
+
+    class Bad:
+        def __iter__(self):
+            yield DataSet(np.zeros((2, 4), np.float32),
+                          np.zeros((2, 2), np.float32))
+            raise OSError("source broke")
+
+        def reset(self):
+            pass
+
+    with pytest.raises(OSError, match="source broke"):
+        list(AsyncDataSetIterator(Bad()))
+    _assert_no_pipeline_threads()
+
+
+# -- multi-worker ETL ---------------------------------------------------------
+
+
+def test_parallel_etl_ordered_reassembly():
+    """Workers finish out of order (adversarial per-item delays); ordered
+    mode must still emit base order, each item exactly once."""
+    items = list(range(16))
+
+    def tf(i):
+        time.sleep(0.005 * ((17 - i) % 5))
+        return DataSet(np.full((2, 3), i, np.float32),
+                       np.zeros((2, 1), np.float32))
+
+    out = [int(b.features[0, 0])
+           for b in ParallelDataSetIterator(items, transform=tf, workers=4)]
+    assert out == items
+    _assert_no_pipeline_threads()
+
+
+def test_parallel_etl_unordered_is_complete():
+    items = list(range(16))
+    tf = lambda i: DataSet(np.full((1, 2), i, np.float32),
+                           np.zeros((1, 1), np.float32))
+    it = ParallelDataSetIterator(items, transform=tf, workers=4,
+                                 ordered=False)
+    assert sorted(int(b.features[0, 0]) for b in it) == items
+    _assert_no_pipeline_threads()
+
+
+def test_parallel_etl_transform_error_surfaces_in_order():
+    items = list(range(10))
+
+    def bad(i):
+        if i == 5:
+            raise ValueError("decode failed")
+        return DataSet(np.full((1, 2), i, np.float32),
+                       np.zeros((1, 1), np.float32))
+
+    got = []
+    with pytest.raises(ValueError, match="decode failed"):
+        for b in ParallelDataSetIterator(items, transform=bad, workers=3):
+            got.append(int(b.features[0, 0]))
+    # ordered mode: everything before the failed position was delivered
+    assert got == [0, 1, 2, 3, 4]
+    _assert_no_pipeline_threads()
+
+
+def test_parallel_etl_close_mid_stream_and_reuse():
+    items = list(range(64))
+    tf = lambda i: DataSet(np.full((1, 2), i, np.float32),
+                           np.zeros((1, 1), np.float32))
+    it = ParallelDataSetIterator(items, transform=tf, workers=3,
+                                 queue_size=3)
+    for i, _ in enumerate(it):
+        if i == 2:
+            break  # workers blocked on the small full queue
+    _assert_no_pipeline_threads()
+    # a fresh epoch over a fresh base works after the aborted one
+    it2 = ParallelDataSetIterator(list(range(6)), transform=tf, workers=2)
+    assert len(list(it2)) == 6
+    _assert_no_pipeline_threads()
+
+
+def test_parallel_etl_feeds_fit():
+    ds = _toy_dataset(n=24)
+    batches = ListDataSetIterator(ds, 4)
+    # identity-transform ETL in front of the full staged pipeline
+    it = ParallelDataSetIterator(list(batches), transform=None, workers=2)
+    net = _toy_net()
+    net.fit(it, epochs=1, async_prefetch=True)
+    assert net.iteration == 6
+    _assert_no_pipeline_threads()
+
+
+# -- device prefetch ----------------------------------------------------------
+
+
+def test_device_prefetch_preplaces_and_marks():
+    import jax
+
+    ds = _toy_dataset(n=12)
+    out = list(DevicePrefetchIterator(ListDataSetIterator(ds, 3), depth=2))
+    assert len(out) == 4
+    assert all(isinstance(b.features, jax.Array) for b in out)
+    assert all(getattr(b, "_pipeline_staged", False) for b in out)
+    np.testing.assert_array_equal(
+        np.concatenate([np.asarray(b.features) for b in out]), ds.features)
+    _assert_no_pipeline_threads()
+
+
+def test_device_prefetch_runs_placement_in_worker_thread():
+    seen_threads = []
+
+    def placement(ds):
+        seen_threads.append(threading.current_thread().name)
+        return ds
+
+    ds = _toy_dataset(n=8)
+    list(DevicePrefetchIterator(ListDataSetIterator(ds, 2), depth=1,
+                                placement=placement))
+    assert len(seen_threads) == 4
+    assert all(n.startswith(PIPELINE_THREAD_PREFIX) for n in seen_threads)
+    _assert_no_pipeline_threads()
+
+
+def test_staged_batch_not_transformed_twice_by_fit_loop():
+    """The fit loop must skip `_batch_transform` for batches the pipeline
+    already staged — one application total, in the worker thread."""
+    calls = []
+
+    def counting_transform(ds):
+        calls.append(threading.current_thread().name)
+        return ds
+
+    net = _toy_net()
+    net._batch_transform = counting_transform
+    net.fit(ListDataSetIterator(_toy_dataset(n=16), 4), epochs=1,
+            async_prefetch=True)
+    assert len(calls) == 4
+    assert all(n.startswith(PIPELINE_THREAD_PREFIX) for n in calls)
+    _assert_no_pipeline_threads()
+
+
+def test_fit_error_mid_epoch_leaves_no_workers():
+    from deeplearning4j_tpu.data.iterators import DataSetIterator
+
+    class Bad(DataSetIterator):
+        def __iter__(self):
+            d = _toy_dataset(n=4)
+            yield DataSet(d.features, d.labels)
+            raise OSError("iterator bug")
+
+    net = _toy_net()
+    with pytest.raises(OSError, match="iterator bug"):
+        net.fit(Bad(), epochs=1, async_prefetch=True)
+    _assert_no_pipeline_threads()
+
+
+def test_cross_thread_close_unblocks_consumer():
+    """close() from another thread must end iteration, not leave the
+    consumer blocked in q.get() (the producer can never deliver its
+    sentinel once stop is set)."""
+
+    from deeplearning4j_tpu.data.iterators import DataSetIterator
+
+    class Slow(DataSetIterator):
+        def __iter__(self):
+            d = _toy_dataset(n=2)
+            yield d
+            time.sleep(1.0)  # consumer blocks waiting for the next batch
+            yield d
+
+    it = AsyncDataSetIterator(Slow(), queue_size=1)
+    consumed = []
+
+    def consume():
+        for b in it:
+            consumed.append(b)
+
+    t = threading.Thread(target=consume)
+    t.start()
+    time.sleep(0.2)  # consumer is now blocked on the empty queue
+    it.close()
+    t.join(timeout=1.0)
+    assert not t.is_alive(), "consumer stayed blocked after close()"
+    assert len(consumed) == 1
+    _assert_no_pipeline_threads()
+
+
+def test_user_prefetch_iterator_must_carry_net_transforms():
+    """A caller-built DevicePrefetchIterator that does not apply the
+    net's configured staging is an error, not silent wrong training."""
+    ds = _toy_dataset(n=16)
+    tr = DeviceBatchTransform(normalize=(0.0, 1.0))
+    net = _toy_net().set_input_transform(tr)
+    with pytest.raises(ValueError, match="input transform"):
+        net.fit(DevicePrefetchIterator(ListDataSetIterator(ds, 4)),
+                epochs=1, async_prefetch=True)
+    # built WITH the transform, the same pipeline is accepted
+    net.fit(DevicePrefetchIterator(ListDataSetIterator(ds, 4), transform=tr),
+            epochs=1, async_prefetch=True)
+    assert net.iteration == 4
+    _assert_no_pipeline_threads()
+
+
+def test_user_prefetch_with_wrapper_sharding_accepted():
+    """The error message's own advice must work: a caller-built pipeline
+    whose placement is the wrapper's shard function is accepted (bound
+    methods are fresh objects per access — equality, not identity)."""
+    from deeplearning4j_tpu.parallel import ParallelWrapper, data_parallel_mesh
+
+    net = _toy_net()
+    pw = ParallelWrapper(net, data_parallel_mesh())
+    it = DevicePrefetchIterator(
+        ListDataSetIterator(_toy_dataset(n=32), 16),
+        placement=pw._shard_batch)
+    pw.fit(it, epochs=1)
+    assert net.iteration == 2
+    _assert_no_pipeline_threads()
+
+
+# -- the tentpole equivalence pin ---------------------------------------------
+
+
+def test_fit_byte_identical_prefetch_on_vs_off():
+    ds = _toy_dataset(n=48, seed=3)
+    nets = {}
+    for on in (False, True):
+        net = _toy_net(seed=11)
+        net.fit(ListDataSetIterator(ds, 8), epochs=3, async_prefetch=on)
+        assert net.iteration == 18
+        nets[on] = net
+    for a, b in zip(nets[False].params_list, nets[True].params_list):
+        assert set(a) == set(b)
+        for k in a:
+            assert np.asarray(a[k]).tobytes() == np.asarray(b[k]).tobytes()
+    s_off = float(np.asarray(nets[False]._score))
+    s_on = float(np.asarray(nets[True]._score))
+    assert s_off == s_on  # exact, not allclose
+    _assert_no_pipeline_threads()
+
+
+def test_fit_epochs_restage_with_device_prefetch():
+    """Each epoch re-runs __iter__ on the pipeline: fresh workers, same
+    data — multi-epoch fits must work and clean up."""
+    net = _toy_net()
+    it = DevicePrefetchIterator(
+        ListDataSetIterator(_toy_dataset(n=16), 4), depth=2)
+    net.fit(it, epochs=3, async_prefetch=True)
+    assert net.iteration == 12
+    _assert_no_pipeline_threads()
+
+
+# -- on-device transforms -----------------------------------------------------
+
+
+def test_device_transform_normalize_matches_numpy():
+    mean, std = 0.25, 2.0
+    t = DeviceBatchTransform(normalize=(mean, std))
+    x = np.random.default_rng(0).random((6, 5)).astype(np.float32)
+    out = np.asarray(t(DataSet(x, np.zeros((6, 1), np.float32))).features)
+    np.testing.assert_allclose(out, (x - mean) / std, rtol=1e-6)
+
+
+def test_device_transform_deterministic_and_shape_keyed():
+    t1 = DeviceBatchTransform(random_flip=True, random_crop=2, seed=9)
+    t2 = DeviceBatchTransform(random_flip=True, random_crop=2, seed=9)
+    rng = np.random.default_rng(1)
+    img = DataSet(rng.random((4, 8, 8, 3)).astype(np.float32),
+                  np.zeros((4, 1), np.float32))
+    a = np.asarray(t1(img).features)
+    b = np.asarray(t2(img).features)
+    np.testing.assert_array_equal(a, b)  # same seed+step: identical
+    c = np.asarray(t1(img).features)
+    assert not np.array_equal(a, c)  # next step: fresh augmentation
+    assert t1.compile_count == 1  # same shape: one trace
+    img2 = DataSet(rng.random((2, 8, 8, 3)).astype(np.float32),
+                   np.zeros((2, 1), np.float32))
+    t1(img2)
+    assert t1.compile_count == 2  # new shape: second trace
+    t2.reset_steps()
+    np.testing.assert_array_equal(np.asarray(t2(img).features), a)
+
+
+def test_device_transform_rejects_augment_on_non_images():
+    t = DeviceBatchTransform(random_flip=True)
+    with pytest.raises(ValueError, match="NHWC"):
+        t(DataSet(np.zeros((4, 10), np.float32),
+                  np.zeros((4, 1), np.float32)))
+
+
+def test_device_transform_identical_in_pipeline_and_inline():
+    """Same transform object, same batch order: fit results must be
+    byte-identical whether the transform runs in the prefetch worker
+    (pipeline on) or inline (pipeline off)."""
+    ds = _toy_dataset(n=32, seed=5)
+    results = []
+    for on in (False, True):
+        net = _toy_net(seed=13)
+        net.set_input_transform(DeviceBatchTransform(normalize=(0.1, 1.5)))
+        net.fit(ListDataSetIterator(ds, 8), epochs=2, async_prefetch=on)
+        results.append([{k: np.asarray(v).tobytes() for k, v in p.items()}
+                        for p in net.params_list])
+    assert results[0] == results[1]
+    _assert_no_pipeline_threads()
+
+
+# -- satellite 2: _ds_examples ------------------------------------------------
+
+
+def test_ds_examples_counts_unknown_sizes_explicitly():
+    from deeplearning4j_tpu.utils.metrics import get_registry
+
+    net = _toy_net()
+    unknown = net._fit_obs()["examples_unknown"]
+    before = unknown.value
+
+    class NoCount:
+        pass
+
+    assert net._ds_examples(NoCount()) == 0
+    assert unknown.value == before + 1
+    # real example counts unaffected
+    assert net._ds_examples(_toy_dataset(n=7)) == 7
+    assert unknown.value == before + 1
+
+
+def test_ds_examples_no_longer_swallows_real_bugs():
+    net = _toy_net()
+
+    class Buggy:
+        def num_examples(self):
+            raise RuntimeError("corrupted shard")
+
+    with pytest.raises(RuntimeError, match="corrupted shard"):
+        net._ds_examples(Buggy())
+
+
+# -- satellite 3: iterator edge cases -----------------------------------------
+
+
+def test_multiple_epochs_iterator_reset_semantics():
+    ds = _toy_dataset(n=12)
+    base = ListDataSetIterator(ds, 4)
+    it = MultipleEpochsIterator(3, base)
+    assert len(list(it)) == 9  # 3 epochs x 3 batches
+    # a second pass resets the base each epoch and yields the same count
+    assert len(list(it)) == 9
+    # and it composes with the async stage
+    assert len(list(AsyncDataSetIterator(it, queue_size=2))) == 9
+    _assert_no_pipeline_threads()
+
+
+def test_stacked_iterator_ragged_tail():
+    ds = _toy_dataset(n=20)
+    base = ListDataSetIterator(ds, 4)  # 5 batches of 4
+    it = StackedDataSetIterator(base, 2)
+    sizes = [b.num_examples() for b in it]
+    assert sizes == [8, 8, 4]  # ragged tail = the leftover single batch
+    total = np.concatenate(
+        [np.asarray(b.features) for b in StackedDataSetIterator(base, 2)])
+    np.testing.assert_array_equal(total, ds.features)
+    assert it.batch_size() == 8
+    assert it.total_examples() == 20
+
+
+def test_stacked_iterator_k_larger_than_stream():
+    ds = _toy_dataset(n=8)
+    it = StackedDataSetIterator(ListDataSetIterator(ds, 4), 5)
+    sizes = [b.num_examples() for b in it]
+    assert sizes == [8]  # everything collapses into one (ragged) stack
+
+
+def test_existing_iterator_with_pipeline_stages():
+    ds = _toy_dataset(n=8)
+    batches = ListDataSetIterator(ds, 2)
+    it = DevicePrefetchIterator(
+        AsyncDataSetIterator(ExistingDataSetIterator(list(batches)), 2),
+        depth=1)
+    assert len(list(it)) == 4
+    assert len(list(it)) == 4  # re-iterable
+    _assert_no_pipeline_threads()
