@@ -58,6 +58,26 @@ def _device_dataset(x, y) -> DataSet:
     return DataSet(jax.device_put(x), jax.device_put(y))
 
 
+def _doctor_refusal(conf, unit):
+    """Honesty mechanism (the PR-2 A/B precedent, applied to model
+    validity): a workload whose model config fails the static doctor at
+    ERROR severity must not headline a throughput number — a broken
+    graph can trace into something fast and wrong. Returns the refusal
+    dict to emit instead of benching, or None when the model is sound."""
+    from deeplearning4j_tpu.analysis import doctor_errors
+
+    errs = doctor_errors(conf)
+    if not errs:
+        return None
+    return {
+        "value": None,
+        "unit": unit,
+        "doctor_errors": [f"{f.name}: {f.message}" for f in errs],
+        "note": "model failed `cli doctor` at ERROR severity; refusing "
+                "to headline a broken model's throughput",
+    }
+
+
 def _sync(net):
     """Force completion. block_until_ready does not actually block through
     the axon tunnel, so synchronize with a host readback of the last
@@ -162,6 +182,9 @@ def bench_resnet50(batch=128, steps=8, image_size=224, classes=1000):
         batch, steps, image_size, classes = 8, 4, 64, 10
     conf = resnet50_conf(num_classes=classes, image_size=image_size,
                          precision="bf16" if on_tpu else "f32")
+    refusal = _doctor_refusal(conf, "images/sec/chip")
+    if refusal is not None:
+        return refusal
     # NO fused multi-batch dispatch here: profiled 98.2 vs 48.8 ms/step
     # device time (PROFILE_resnet50.md) — the scan-carried params defeat
     # XLA's layout/fusion choices on this compute-bound model, while
@@ -272,6 +295,12 @@ def bench_char_lstm(batch=64, seq_len=200, tbptt=50, vocab=77, hidden=200,
     y = np.eye(vocab, dtype=np.float32)[yidx]
     ds = _device_dataset(x, y)
     segments = -(-seq_len // tbptt)
+    refusal = _doctor_refusal(
+        char_lstm_conf(vocab_size=vocab, hidden=hidden, tbptt_length=tbptt,
+                       precision="bf16" if on_tpu else "f32"),
+        "tokens/sec/chip")
+    if refusal is not None:
+        return refusal
 
     def run(kernel_on):
         set_helper_enabled("lstm_sequence", kernel_on)
@@ -484,7 +513,8 @@ def bench_parallel_inference(max_batch=64, n_requests=512, clients=16,
         except BaseException as e:
             client_errors.append(f"{type(e).__name__}: {e}")
 
-    threads = [threading.Thread(target=client) for _ in range(clients)]
+    threads = [threading.Thread(target=client, name=f"dl4j-bench-client-{i}")
+               for i in range(clients)]
     t0 = time.perf_counter()
     for t in threads:
         t.start()

@@ -1,0 +1,99 @@
+"""Static analysis: model doctor, jaxpr auditor, concurrency lint.
+
+Three passes over three artifact kinds, unified behind structured
+findings (analysis/findings.py) and surfaced as `cli doctor` /
+`cli lint`:
+
+1. shapeflow  — symbolic InputType propagation over nn/conf
+   configurations (no params, no tracing): nIn/nOut wiring, missing
+   preprocessors, merge conflicts, dead vertices. SF*** codes.
+2. jaxpr_audit — one abstract trace of the train-step loss, walked for
+   TPU hazards: f64, widening casts, folded constants, host callbacks,
+   dead weights, non-donated buffers. JX*** codes.
+3. lint — AST checks over the repo's own source for the concurrency
+   conventions (bare except, timeout-less queue ops, unnamed/non-daemon
+   threads, lock-order cycles, stray print). CC*** codes, gated in
+   scripts/lint.sh against scripts/lint_baseline.txt.
+
+The DL4J lineage: the reference's config DSL validated nIn/nOut wiring
+before any compute ran (InputTypeUtil; MIGRATION.md "config
+validation") — this package is that idea extended to the jaxpr program
+and to the codebase itself.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import List
+
+from deeplearning4j_tpu.analysis.findings import (  # noqa: F401
+    ERROR,
+    INFO,
+    WARNING,
+    Finding,
+    error_names,
+    format_findings,
+    has_errors,
+    sort_findings,
+    summarize,
+    to_json,
+)
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+
+def doctor_network(net, *, batch_size: int = 2, timesteps: int = 8,
+                   jaxpr: bool = True) -> List[Finding]:
+    """The model doctor: shapeflow over the net's configuration, then —
+    when the config is sound — one abstract trace of the train-step loss
+    audited for TPU hazards. Returns findings; raises nothing on a bad
+    model (that is the point)."""
+    from deeplearning4j_tpu.analysis import jaxpr_audit, shapeflow
+
+    findings = shapeflow.check_configuration(net.conf)
+    if jaxpr and not has_errors(findings):
+        # a config with ERRORs would abstract-trace into the same wreck
+        # it describes; report the config layer first. The trace can
+        # still fail on warning-grade configs (e.g. SF007 no loss head
+        # -> _loss raises) — that failure becomes a finding, never a
+        # doctor crash
+        try:
+            findings = findings + jaxpr_audit.audit_network(
+                net, batch_size=batch_size, timesteps=timesteps)
+        except Exception as e:
+            findings = findings + [Finding(
+                "JX000", WARNING, "jaxpr:train_loss",
+                f"could not abstract-trace the train-step loss: "
+                f"{type(e).__name__}: {e}",
+                "resolve the config findings above (a missing loss head "
+                "or broken wiring usually explains this)")]
+    return findings
+
+
+def doctor_errors(conf) -> List[Finding]:
+    """ERROR-severity shapeflow findings for a configuration — the cheap
+    gate bench.py consults before headlining a workload."""
+    from deeplearning4j_tpu.analysis import shapeflow
+
+    return [f for f in shapeflow.check_configuration(conf)
+            if f.severity == ERROR]
+
+
+def preflight_report(conf, origin: str = "") -> List[Finding]:
+    """Free pre-flight check on an imported model configuration
+    (keras/dl4j import paths): run shapeflow, log what it finds, return
+    the findings. Never raises — an analysis bug must not sink an
+    import that would otherwise succeed."""
+    from deeplearning4j_tpu.analysis import shapeflow
+
+    try:
+        findings = shapeflow.check_configuration(conf)
+    except Exception as e:
+        logger.debug("import preflight skipped (%s): %s", origin, e)
+        return []
+    src = f" [{origin}]" if origin else ""
+    for f in sort_findings(findings):
+        level = logging.WARNING if f.severity == ERROR else (
+            logging.INFO if f.severity == WARNING else logging.DEBUG)
+        logger.log(level, "import preflight%s: %s", src, f.format())
+    return findings

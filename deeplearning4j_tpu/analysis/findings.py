@@ -1,0 +1,113 @@
+"""Structured static-analysis findings.
+
+Every analysis pass (shapeflow config checker, jaxpr program auditor,
+concurrency lint) reports the same record: a short stable code, a
+severity, where it happened, what is wrong and how to fix it. The
+uniform shape is what lets `cli doctor` / `cli lint` share JSON output,
+exit-code policy, and the baseline name-diff gate in scripts/lint.sh
+(the same pattern as tests/tier1_baseline_failures.txt).
+
+Finding codes (the stable vocabulary — documented in README "Static
+analysis"; tests pin one fixture per code):
+
+shapeflow (config graph, no params built, no tracing):
+  SF001  nIn/nOut wiring mismatch (or unset) on a parameterized layer
+  SF002  input-family mismatch / missing preprocessor between layers
+  SF003  merge-vertex fan-in conflict (mixed kinds, unequal h/w/timesteps)
+  SF004  dead or unreachable vertex / unused graph input / cycle
+  SF005  vertex shape conflict (elementwise arity, subset out of range)
+  SF006  precision promotion point (bf16 compute -> f32 loss head)
+  SF007  no trainable loss head (fit() would fail)
+
+jaxpr audit (abstract trace of the train-step loss):
+  JX001  float64 value inside the program (TPU runs it 10-100x slow)
+  JX002  widening float cast (bf16/f16 -> f32, f32 -> f64) in the graph
+  JX003  large constant folded into the program (recompiled per trace,
+         resident per executable)
+  JX004  host callback inside jit (forces device->host sync per step)
+  JX005  parameter with no cotangent path to the loss (dead weight)
+  JX006  train-step buffers not donated on a device backend (peak
+         memory doubles)
+
+concurrency lint (AST over the repo itself):
+  CC001  bare `except:`
+  CC002  queue put/get without timeout/abort in thread code
+  CC003  thread without a name (dl4j-* naming convention)
+  CC004  thread neither daemon nor joined
+  CC005  lock-order cycle across nested `with <lock>:` scopes
+  CC006  stray print() in library code (use the package logger)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Iterable, List
+
+ERROR = "error"
+WARNING = "warning"
+INFO = "info"
+
+_SEVERITY_RANK = {ERROR: 0, WARNING: 1, INFO: 2}
+
+
+@dataclasses.dataclass
+class Finding:
+    code: str        # "SF001" / "JX004" / "CC002" ...
+    severity: str    # ERROR | WARNING | INFO
+    location: str    # "layer[3]:dense_1" / "vertex:s1b0_add" / "path.py:42"
+    message: str     # what is wrong, concretely
+    fix_hint: str = ""   # the shortest path to green
+    name: str = ""       # stable id for baseline diffs (no line numbers)
+
+    def __post_init__(self):
+        if not self.name:
+            self.name = f"{self.code}:{self.location}"
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def format(self) -> str:
+        hint = f"  [fix: {self.fix_hint}]" if self.fix_hint else ""
+        return (f"{self.severity.upper():<7} {self.code} {self.location}: "
+                f"{self.message}{hint}")
+
+
+def sort_findings(findings: Iterable[Finding]) -> List[Finding]:
+    """Severity-major order (errors first), then code, then location."""
+    return sorted(findings,
+                  key=lambda f: (_SEVERITY_RANK.get(f.severity, 3),
+                                 f.code, f.location))
+
+
+def has_errors(findings: Iterable[Finding]) -> bool:
+    return any(f.severity == ERROR for f in findings)
+
+
+def error_names(findings: Iterable[Finding]) -> List[str]:
+    return sorted({f.name for f in findings if f.severity == ERROR})
+
+
+def summarize(findings: Iterable[Finding]) -> dict:
+    fs = list(findings)
+    by = {ERROR: 0, WARNING: 0, INFO: 0}
+    for f in fs:
+        by[f.severity] = by.get(f.severity, 0) + 1
+    return {
+        "ok": by[ERROR] == 0,
+        "errors": by[ERROR],
+        "warnings": by[WARNING],
+        "infos": by[INFO],
+        "findings": [f.to_dict() for f in sort_findings(fs)],
+    }
+
+
+def to_json(findings: Iterable[Finding]) -> str:
+    return json.dumps(summarize(findings), indent=2)
+
+
+def format_findings(findings: Iterable[Finding]) -> str:
+    fs = sort_findings(findings)
+    if not fs:
+        return "no findings"
+    return "\n".join(f.format() for f in fs)

@@ -287,6 +287,76 @@ def cmd_metrics(args) -> int:
     return 0
 
 
+def cmd_doctor(args) -> int:
+    """Model doctor: static shape/dtype-flow check of a model's
+    configuration plus a jaxpr audit of its train-step loss
+    (analysis/shapeflow + analysis/jaxpr_audit via net.doctor()). Exit 0
+    when no ERROR-severity finding; 1 otherwise — scriptable as a
+    pre-training/pre-serving gate."""
+    import json as _json
+
+    from deeplearning4j_tpu.analysis import (
+        format_findings,
+        has_errors,
+        summarize,
+    )
+
+    if bool(args.model_path) == bool(args.preset):
+        print("doctor: pass exactly one of --model-path or --preset",
+              file=sys.stderr)
+        return 2
+    if args.model_path:
+        net = guess_and_load_model(args.model_path)
+    else:
+        net = _preset_network(args)
+    findings = net.doctor(batch_size=args.batch, timesteps=args.timesteps,
+                          jaxpr=not args.no_jaxpr)
+    if args.json == "-":
+        print(_json.dumps(summarize(findings), indent=2))
+    elif args.json:
+        with open(args.json, "w") as f:
+            _json.dump(summarize(findings), f, indent=2)
+        print(f"wrote {args.json}")
+    else:
+        print(format_findings(findings))
+    return 1 if has_errors(findings) else 0
+
+
+def _preset_network(args):
+    """Built-in model configs for doctor runs without a serialized model."""
+    preset = args.preset
+    if preset == "resnet50":
+        from deeplearning4j_tpu.models.resnet import resnet50_network
+
+        return resnet50_network(num_classes=args.classes or 1000,
+                                image_size=args.image_size or 224)
+    if preset == "tiny_resnet":
+        from deeplearning4j_tpu.models.resnet import tiny_resnet_conf
+        from deeplearning4j_tpu.nn.compgraph import ComputationGraph
+
+        return ComputationGraph(tiny_resnet_conf()).init()
+    if preset == "charlstm":
+        from deeplearning4j_tpu.models.charlstm import char_lstm_network
+
+        return char_lstm_network()
+    raise SystemExit(f"unknown --preset {preset!r} "
+                     "(resnet50|tiny_resnet|charlstm)")
+
+
+def cmd_lint(args) -> int:
+    """Concurrency/robustness lint over source paths (analysis/lint.py,
+    CC001-CC006). The t1 gate wraps this via scripts/lint.sh with the
+    committed baseline; here it is exposed directly for ad-hoc runs."""
+    from deeplearning4j_tpu.analysis.lint import main as lint_main
+
+    argv = list(args.paths)
+    if args.json:
+        argv += ["--json", args.json]
+    if args.baseline:
+        argv += ["--baseline", args.baseline]
+    return lint_main(argv)
+
+
 def main(argv=None) -> int:
     # honor JAX_PLATFORMS even when a sitecustomize imported jax before
     # this process's env was consulted (config update beats env once the
@@ -381,6 +451,44 @@ def main(argv=None) -> int:
                    help="write to this file instead of stdout")
     m.add_argument("--timeout", type=float, default=10.0)
     m.set_defaults(fn=cmd_metrics)
+
+    d = sub.add_parser(
+        "doctor",
+        help="static model analysis: config shape/dtype flow + jaxpr "
+             "train-step audit (exit 1 on ERROR findings)")
+    d.add_argument("--model-path", default=None,
+                   help="serialized model (this framework's zip, DL4J zip, "
+                        "or Keras .h5)")
+    d.add_argument("--preset", default=None,
+                   help="built-in config instead of a file: "
+                        "resnet50|tiny_resnet|charlstm")
+    d.add_argument("--image-size", type=int, default=None,
+                   help="override preset image size (resnet50)")
+    d.add_argument("--classes", type=int, default=None,
+                   help="override preset class count (resnet50)")
+    d.add_argument("--batch", type=int, default=2,
+                   help="abstract batch size for the jaxpr audit")
+    d.add_argument("--timesteps", type=int, default=8,
+                   help="abstract sequence length for recurrent models")
+    d.add_argument("--no-jaxpr", action="store_true",
+                   help="config shapeflow only (skip the abstract trace)")
+    d.add_argument("--json", default=None, metavar="PATH",
+                   help="machine-readable findings ('-' = stdout)")
+    d.set_defaults(fn=cmd_doctor)
+
+    ln = sub.add_parser(
+        "lint",
+        help="concurrency/robustness lint over source paths "
+             "(analysis/lint.py; scripts/lint.sh is the gated form)")
+    ln.add_argument("paths", nargs="*",
+                    help="files/dirs (default: deeplearning4j_tpu + "
+                         "bench.py)")
+    ln.add_argument("--json", default=None, metavar="PATH",
+                    help="machine-readable findings ('-' = stdout)")
+    ln.add_argument("--baseline", default=None, metavar="FILE",
+                    help="suppress baselined ERROR names; exit 1 only on "
+                         "new ones")
+    ln.set_defaults(fn=cmd_lint)
 
     args = ap.parse_args(argv)
     return args.fn(args)

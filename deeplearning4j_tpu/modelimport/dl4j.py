@@ -566,6 +566,12 @@ def import_dl4j_multilayer(path: str, precision: str = "f32",
     net.iteration = iteration
     if upd_flat is not None:
         restore_updater_state(net, np.asarray(upd_flat).reshape(-1))
+    # free pre-flight: shapeflow over the translated configuration — a
+    # mistranslated zip is diagnosed at import (logged findings, also on
+    # net.import_preflight), not five layers deep at trace time
+    from deeplearning4j_tpu.analysis import preflight_report
+
+    net.import_preflight = preflight_report(net.conf, origin=path)
     return net
 
 
@@ -866,6 +872,9 @@ def import_dl4j_computation_graph(path: str, precision: str = "f32",
                  for n in topo if n in layer_confs]
         restore_updater_state(net, np.asarray(upd_flat).reshape(-1),
                               indexed_layer_confs=pairs)
+    from deeplearning4j_tpu.analysis import preflight_report
+
+    net.import_preflight = preflight_report(net.conf, origin=path)
     return net
 
 

@@ -677,7 +677,14 @@ def import_keras_sequential_model_and_weights(
     finally:
         f.close()
     net = MultiLayerNetwork(conf).init()
-    return _apply_weights(net, layer_names, weights, _dim_ordering_of(mc))
+    net = _apply_weights(net, layer_names, weights, _dim_ordering_of(mc))
+    # free pre-flight: shapeflow over the translated configuration — a
+    # mistranslated archive is diagnosed at import (logged findings, also
+    # on net.import_preflight), not at trace time
+    from deeplearning4j_tpu.analysis import preflight_report
+
+    net.import_preflight = preflight_report(net.conf, origin=path)
+    return net
 
 
 def import_keras_model_and_weights(
@@ -706,6 +713,9 @@ def import_keras_model_and_weights(
         if f.id.valid:
             f.close()
     net = ComputationGraph(conf).init()
+    from deeplearning4j_tpu.analysis import preflight_report
+
+    net.import_preflight = preflight_report(net.conf, origin=path)
     dim_ordering = _dim_ordering_of(mc)
     # graph params are keyed by vertex order; map vertex name -> index
     confs = {}

@@ -388,8 +388,7 @@ class ComputationGraph(NetworkBase):
             return body(params, states, upd_state,
                         (xs, ys, f_masks, l_masks), lr, t, rng)
 
-        backend = jax.default_backend()
-        donate = (0, 2) if backend != "cpu" else ()
+        donate = self._step_donate_argnums()
         return jax.jit(step, donate_argnums=donate)
 
     def _fit_step(self, xs, ys, f_masks, l_masks, stateful_states=None):
@@ -528,8 +527,7 @@ class ComputationGraph(NetworkBase):
                 (xs, ys, fms, lms, lrs, jnp.arange(K, dtype=jnp.uint32)))
             return params, states, upd_state, scores[-1]
 
-        backend = jax.default_backend()
-        donate = (0, 2) if backend != "cpu" else ()
+        donate = self._step_donate_argnums()
         return jax.jit(step, donate_argnums=donate)
 
     def _fit_tbptt(self, mds: MultiDataSet):
@@ -683,8 +681,7 @@ class ComputationGraph(NetworkBase):
                 jnp.arange(1, n_seg))
             return params, states, upd_state, scores[-1]
 
-        backend = jax.default_backend()
-        donate = (0, 2) if backend != "cpu" else ()
+        donate = self._step_donate_argnums()
         return jax.jit(step, donate_argnums=donate)
 
     def _fit_tbptt_fused(self, mds: MultiDataSet, n_seg: int, seg: int,
@@ -724,8 +721,7 @@ class ComputationGraph(NetworkBase):
             body = self._make_step_body(
                 self._trunc_loss_builder(),
                 collect=bool(getattr(self, "_collect_stats", False)))
-            backend = jax.default_backend()
-            donate = (0, 2) if backend != "cpu" else ()
+            donate = self._step_donate_argnums()
             self._trunc_step_fn = jax.jit(body, donate_argnums=donate)
             self._note_compile("train_step_truncated")
 

@@ -333,8 +333,7 @@ class MultiLayerNetwork(NetworkBase):
         step = self._make_step_body(
             loss_builder, collect=bool(getattr(self, "_collect_stats", False))
         )
-        backend = jax.default_backend()
-        donate = (0, 2) if backend != "cpu" else ()
+        donate = self._step_donate_argnums()
         return jax.jit(step, donate_argnums=donate)
 
     def _std_loss_builder(self):
@@ -468,8 +467,7 @@ class MultiLayerNetwork(NetworkBase):
             scores = jnp.concatenate([s0[None], scores])
             return params, states, upd_state, scores, last
 
-        backend = jax.default_backend()
-        donate = (0, 2) if backend != "cpu" else ()
+        donate = self._step_donate_argnums()
         return jax.jit(step, donate_argnums=donate)
 
     def _run_step(self, step_fn, data, stateful_states=None):
@@ -847,8 +845,7 @@ class MultiLayerNetwork(NetworkBase):
                 (data_stack, lrs, jnp.arange(K, dtype=jnp.uint32)))
             return params, states, upd_state, scores[-1]
 
-        backend = jax.default_backend()
-        donate = (0, 2) if backend != "cpu" else ()
+        donate = self._step_donate_argnums()
         return jax.jit(step, donate_argnums=donate)
 
     def _fit_std_batched(self, ds_list):
@@ -941,8 +938,7 @@ class MultiLayerNetwork(NetworkBase):
                 jnp.arange(1, K))
             return params, states, upd_state, lasts[-1]
 
-        backend = jax.default_backend()
-        donate = (0, 2) if backend != "cpu" else ()
+        donate = self._step_donate_argnums()
         return jax.jit(step, donate_argnums=donate)
 
     def _fit_tbptt_batched(self, ds_list, n_seg: int, seg: int, bwd: int):

@@ -72,6 +72,10 @@ class NetworkBase:
         # the per-step hot path touches cached children only (the ISSUE's
         # overhead guard: zero registry lookups per step)
         self._fit_instruments = None
+        # donate_argnums the step builders actually used (recorded by
+        # _step_donate_argnums) — the doctor's JX006 check audits THIS,
+        # not a reconstruction of the policy
+        self._donate_argnums = None
 
     # -- to be provided by subclasses ----------------------------------------
 
@@ -124,6 +128,40 @@ class NetworkBase:
             ("kind",)).labels(kind).inc()
         _tracing.instant("compile", kind=kind,
                          key=None if key is None else str(key))
+
+    def _step_donate_argnums(self):
+        """donate_argnums for jitted optimizer steps: params (0) and
+        updater state (2) are donated on device backends so the update
+        reuses their buffers instead of holding old+new copies; cpu
+        makes donation a no-op (jax warns), so it is skipped there. The
+        ONE definition every step builder uses — and records on the net,
+        so analysis/jaxpr_audit's JX006 check audits the value the jits
+        actually got, not a parallel reconstruction of this rule."""
+        import jax
+
+        donate = (0, 2) if jax.default_backend() != "cpu" else ()
+        self._donate_argnums = donate
+        return donate
+
+    # -- static analysis -----------------------------------------------------
+
+    def doctor(self, *, batch_size: int = 2, timesteps: int = 8,
+               jaxpr: bool = True):
+        """Pre-flight static analysis of this network: shape/dtype flow
+        over the configuration (analysis/shapeflow — nIn/nOut wiring,
+        missing preprocessors, merge conflicts, dead vertices) and, when
+        the config is sound and `jaxpr` is True, one abstract trace of
+        the train-step loss audited for TPU hazards (analysis/jaxpr_audit
+        — f64, widening casts, folded constants, host callbacks, dead
+        weights, donation). No compile, no device step, no mutation.
+
+        Returns a list of analysis.Finding; `cli doctor` is this method
+        with a command line. Opt-in by design — construction stays
+        cheap; call it before committing real device time to a model."""
+        from deeplearning4j_tpu.analysis import doctor_network
+
+        return doctor_network(self, batch_size=batch_size,
+                              timesteps=timesteps, jaxpr=jaxpr)
 
     # -- listeners -----------------------------------------------------------
 

@@ -53,6 +53,11 @@ from deeplearning4j_tpu.parallel.mesh import (
 )
 from deeplearning4j_tpu.utils import metrics as _metrics
 from deeplearning4j_tpu.utils import tracing as _tracing
+from deeplearning4j_tpu.utils.concurrency import (
+    QueueAborted,
+    get_abortable,
+    put_abortable,
+)
 
 
 class InferenceMode:
@@ -175,9 +180,11 @@ class ParallelInference:
         self._dispatch_t: Optional[threading.Thread] = None
         if self.mode == InferenceMode.BATCHED:
             self._collect_t = threading.Thread(
-                target=self._collector, daemon=True)
+                target=self._collector, daemon=True,
+                name="dl4j-serving-collector")
             self._dispatch_t = threading.Thread(
-                target=self._dispatcher, daemon=True)
+                target=self._dispatcher, daemon=True,
+                name="dl4j-serving-dispatch")
             self._collect_t.start()
             self._dispatch_t.start()
 
@@ -218,7 +225,9 @@ class ParallelInference:
             if (self.mode == InferenceMode.BATCHED
                     and xx.shape[0] <= self.max_batch_size):
                 fut = Future()
-                self._q.put((xx, fut))
+                # put_nowait: the request queue is unbounded, so this is
+                # exactly `put` — minus the lint-rejected blocking form
+                self._q.put_nowait((xx, fut))
         if fut is not None:
             return fut.result()
         # SEQUENTIAL mode, or an oversized request: run it alone instead of
@@ -281,8 +290,9 @@ class ParallelInference:
         if self._collect_t is not None:
             # the admission lock above guarantees the sentinel is the LAST
             # item: everything already queued drains normally (served),
-            # then the pipeline exits stage by stage
-            self._q.put(None)
+            # then the pipeline exits stage by stage (unbounded queue:
+            # put_nowait is exact)
+            self._q.put_nowait(None)
             self._collect_t.join(timeout=10)
             self._dispatch_t.join(timeout=10)
             workers_exited = (not self._collect_t.is_alive()
@@ -385,6 +395,24 @@ class ParallelInference:
         padded, n, b = self._pad(xx)
         return self._forward_padded(padded, n, b, count)
 
+    def _put_handoff(self, item, futs=()) -> bool:
+        """Backpressured put toward the dispatcher. Blocks while the
+        device is a full group behind (that IS the backpressure), but
+        aborts — failing the group's futures instead of wedging the
+        collector forever — if the dispatcher thread died."""
+        try:
+            put_abortable(
+                self._handoff, item,
+                abort=lambda: (self._dispatch_t is not None
+                               and not self._dispatch_t.is_alive()))
+            return True
+        except QueueAborted:
+            for fut in futs:
+                if not fut.done():
+                    fut.set_exception(RuntimeError(
+                        "ParallelInference dispatcher thread died"))
+            return False
+
     # BATCHED pipeline, stage 1: drain + concatenate + pad on the host
     def _collector(self):
         pending = None  # request that would overflow the current group
@@ -392,9 +420,12 @@ class ParallelInference:
             if pending is not None:
                 item, pending = pending, None
             else:
-                item = self._q.get()
+                # poll-loop get (no abort predicate: the shutdown
+                # sentinel is the exit protocol — it must drain the queue
+                # in order, so the collector never exits ahead of it)
+                item = get_abortable(self._q)
             if item is None:
-                self._handoff.put(None)
+                self._put_handoff(None)
                 return
             group = [item]
             count = item[0].shape[0]
@@ -406,7 +437,7 @@ class ParallelInference:
                     break
                 if nxt is None:
                     self._emit(group)
-                    self._handoff.put(None)
+                    self._put_handoff(None)
                     return
                 if (count + nxt[0].shape[0] > self.max_batch_size
                         or nxt[0].shape[1:] != item[0].shape[1:]):
@@ -435,15 +466,25 @@ class ParallelInference:
                     fut.set_exception(e)
             return
         t0 = time.perf_counter()
-        self._handoff.put(
-            (padded, n, b, [fut for _, fut in group],
-             [g[0].shape[0] for g in group]))
+        futs = [fut for _, fut in group]
+        self._put_handoff(
+            (padded, n, b, futs, [g[0].shape[0] for g in group]), futs)
         self._m_handoff.observe(time.perf_counter() - t0)
 
     # BATCHED pipeline, stage 2: device forward + scatter results
     def _dispatcher(self):
         while True:
-            work = self._handoff.get()
+            try:
+                # exits on the collector's sentinel; the abort predicate
+                # covers a collector that died WITHOUT delivering one, so
+                # the dispatcher cannot outlive its feeder
+                work = get_abortable(
+                    self._handoff,
+                    abort=lambda: (self._collect_t is not None
+                                   and not self._collect_t.is_alive()
+                                   and self._handoff.empty()))
+            except QueueAborted:
+                return
             if work is None:
                 return
             padded, n, b, futs, sizes = work

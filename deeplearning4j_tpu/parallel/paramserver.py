@@ -45,6 +45,11 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from deeplearning4j_tpu.utils import metrics as _metrics
+from deeplearning4j_tpu.utils.concurrency import (
+    QueueAborted,
+    get_abortable,
+    put_abortable,
+)
 from deeplearning4j_tpu.utils.jsonhttp import JsonHttpServer, json_response
 
 logger = logging.getLogger("deeplearning4j_tpu")
@@ -209,7 +214,9 @@ class EmbeddingPSClient:
         self._m_dropped = reg.counter(
             "paramserver_client_push_dropped_total",
             "push batches lost to dead/misbehaving endpoints").labels()
-        self._worker = threading.Thread(target=self._drain, daemon=True)
+        self._stop = threading.Event()
+        self._worker = threading.Thread(target=self._drain, daemon=True,
+                                        name="dl4j-paramserver-push")
         self._worker.start()
 
     def _owner(self, row: int) -> int:
@@ -265,17 +272,48 @@ class EmbeddingPSClient:
         if deltas.ndim != 2 or deltas.shape[0] != np.asarray(rows).size:
             raise ValueError(  # fail at the call site, not in the drain
                 f"deltas must be [n_rows, dim], got {deltas.shape}")
+        item = (table, np.asarray(rows, np.int64),
+                np.asarray(deltas, np.float32))
+        if self._stop.is_set() or not self._worker.is_alive():
+            # the drain is gone: an enqueue would never be serviced —
+            # count the drop instead of losing gradient mass silently
+            self.dropped_pushes += 1
+            self._m_dropped.inc()
+            logger.warning("PS push dropped (%d total): drain thread gone",
+                           self.dropped_pushes)
+            return
         try:
-            self._q.put_nowait((table, np.asarray(rows, np.int64),
-                                np.asarray(deltas, np.float32)))
+            self._q.put_nowait(item)
         except queue.Full:
-            # backpressure: block — dropping would lose gradient mass
-            self._q.put((table, np.asarray(rows, np.int64),
-                         np.asarray(deltas, np.float32)))
+            # backpressure: block — dropping would lose gradient mass.
+            # Abortable: if the drain thread died (or close() ran), a
+            # blocked producer counts a drop instead of wedging forever
+            try:
+                put_abortable(self._q, item,
+                              abort=lambda: (self._stop.is_set()
+                                             or not self._worker.is_alive()))
+            except QueueAborted:
+                self.dropped_pushes += 1
+                self._m_dropped.inc()
+                logger.warning(
+                    "PS push dropped (%d total): drain thread gone",
+                    self.dropped_pushes)
+
+    def close(self):
+        """Stop accepting pushes and retire the drain thread. Pushes
+        already queued are still delivered (get_abortable drains the
+        queue before honoring the stop), so close() waits up to ~10s;
+        against a dead endpoint delivery can outlast the join timeout —
+        the daemon thread then finishes (or dies) on its own."""
+        self._stop.set()
+        self._worker.join(timeout=10)
 
     def _drain(self):
         while True:
-            table, rows, deltas = self._q.get()
+            try:
+                table, rows, deltas = get_abortable(self._q, self._stop)
+            except QueueAborted:
+                return
             try:
                 for s, url in enumerate(self.urls):
                     sel = np.nonzero(rows % len(self.urls) == s)[0]

@@ -24,6 +24,7 @@ import urllib.request
 from typing import Callable, Dict, List, Optional
 
 from deeplearning4j_tpu.ui.codec import decode_record, encode_record
+from deeplearning4j_tpu.utils.concurrency import QueueAborted, get_abortable
 
 
 class StatsStorageRouter:
@@ -289,12 +290,26 @@ class RemoteUIStatsStorageRouter(StatsStorageRouter):
         self.url = url.rstrip("/")
         self.timeout = timeout
         self._q: "queue.Queue[tuple]" = queue.Queue(maxsize=queue_size)
-        self._worker = threading.Thread(target=self._drain, daemon=True)
+        self._stop = threading.Event()
+        self._worker = threading.Thread(target=self._drain, daemon=True,
+                                        name="dl4j-ui-remote-router")
         self._worker.start()
+
+    def close(self):
+        """Stop accepting records and retire the drain thread. Records
+        already queued are still posted (the drain empties the queue
+        before honoring the stop); close() waits up to ~10s for that —
+        call flush() first when delivery must be confirmed."""
+        self._stop.set()
+        self._worker.join(timeout=10)
 
     def _drain(self):
         while True:
-            route, session_id, body, ctype = self._q.get()
+            try:
+                route, session_id, body, ctype = get_abortable(
+                    self._q, self._stop)
+            except QueueAborted:
+                return
             req = urllib.request.Request(
                 f"{self.url}{route}", data=body,
                 headers={"Content-Type": ctype,

@@ -95,7 +95,8 @@ class JsonHttpServer:
         self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port), _H)
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(target=self._httpd.serve_forever,
-                                        daemon=True)
+                                        daemon=True,
+                                        name=f"dl4j-http-{self.port}")
         self._thread.start()
         return self.port
 

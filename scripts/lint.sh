@@ -1,0 +1,43 @@
+#!/usr/bin/env bash
+# Concurrency/robustness lint gate (analysis/lint.py, CC001-CC006).
+#
+# Same gate semantics as scripts/t1.sh: the exit status reports
+# REGRESSIONS, not raw findings. ERROR-severity finding NAMES (stable
+# `CODE:path:scope` ids — no line numbers, so they survive unrelated
+# edits) are written to an artifact ($LINT_FINDINGS_ARTIFACT, default
+# /tmp/_lint_findings.txt) and diffed against the committed
+# scripts/lint_baseline.txt:
+#   exit 0 — no ERROR finding that is not already in the baseline
+#   exit 1 — new ERROR findings (they are listed)
+#   exit 2 — the linter itself failed to run
+# WARNING/INFO findings never gate; see them with
+#   python -m deeplearning4j_tpu.analysis.lint
+set -o pipefail
+cd "$(dirname "$0")/.."
+
+artifact="${LINT_FINDINGS_ARTIFACT:-/tmp/_lint_findings.txt}"
+baseline="scripts/lint_baseline.txt"
+
+# clear any stale artifact first: a linter that crashes BEFORE writing
+# must leave nothing behind for the diff to false-green against
+rm -f "$artifact"
+python -m deeplearning4j_tpu.analysis.lint --quiet --errors-out "$artifact"
+rc=$?
+if [ ! -f "$artifact" ] || [ "$rc" -gt 1 ]; then
+    echo "LINT: linter failed to run (rc=$rc)"
+    exit 2
+fi
+
+new_findings=$(comm -13 <(grep -v '^#' "$baseline" | sort -u) \
+                        <(sort -u "$artifact"))
+if [ -n "$new_findings" ]; then
+    echo "LINT REGRESSIONS — ERROR findings not in $baseline:"
+    echo "$new_findings"
+    echo "LINT: fix them (see 'python -m deeplearning4j_tpu.analysis.lint'" \
+         "for details/fix hints); only grow the baseline for a deliberate," \
+         "reviewed exemption"
+    exit 1
+fi
+echo "LINT OK: $(wc -l < "$artifact" | tr -d ' ') ERROR finding(s), all" \
+     "within the baseline; artifact: $artifact"
+exit 0

@@ -17,27 +17,12 @@
 set -o pipefail
 cd "$(dirname "$0")/.."
 
-# -- print-lint guard --------------------------------------------------------
-# Library code must log via the "deeplearning4j_tpu" logger, not print
-# (deeplearning4j_tpu/__init__.py configure_logging). New `print(` call
-# sites in deeplearning4j_tpu/ outside cli.py fail the run; existing ones
-# are grandfathered per-file in scripts/print_baseline.txt.
-lint_fail=0
-while IFS= read -r entry; do
-    file=${entry%%:*}
-    count=${entry##*:}
-    [ "$file" = "deeplearning4j_tpu/cli.py" ] && continue
-    allowed=$(awk -v f="$file" '$2 == f {print $1}' scripts/print_baseline.txt)
-    allowed=${allowed:-0}
-    if [ "$count" -gt "$allowed" ]; then
-        echo "T1 LINT: $file has $count print( calls (baseline $allowed) —" \
-             "use the deeplearning4j_tpu logger, or update scripts/print_baseline.txt"
-        lint_fail=1
-    fi
-done < <(grep -rcE '(^|[^A-Za-z0-9_.])print\(' --include='*.py' deeplearning4j_tpu/ | awk -F: '$2 > 0')
-if [ "$lint_fail" -ne 0 ]; then
-    exit 1
-fi
+# -- static-analysis gate ----------------------------------------------------
+# Concurrency/robustness lint (analysis/lint.py: bare except, timeout-less
+# queue ops, unnamed/non-daemon threads, lock-order cycles, stray print)
+# diffed against the committed scripts/lint_baseline.txt. This subsumes
+# the old inline print-grep guard (print is finding code CC006).
+bash scripts/lint.sh || exit 1
 
 # -- the canonical tier-1 pytest run -----------------------------------------
 # T1_METRICS_DUMP=1 makes tests/conftest.py write the shared metrics

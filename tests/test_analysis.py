@@ -1,0 +1,593 @@
+"""Static-analysis subsystem tests (deeplearning4j_tpu/analysis/).
+
+Three passes, one contract each:
+- shapeflow: deliberately broken configs yield their documented SF***
+  finding code; the shipped resnet50/charlstm configs yield zero ERRORs.
+- jaxpr audit: injected f64 constants, large folded constants, host
+  callbacks, and dead params are flagged (JX***); clean nets audit clean.
+- concurrency lint: one fixture per CC*** code; the committed tree has
+  no ERROR finding outside scripts/lint_baseline.txt (the same
+  invariant scripts/lint.sh gates in t1).
+"""
+
+from __future__ import annotations
+
+import json
+import textwrap
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.analysis import (
+    ERROR,
+    WARNING,
+    doctor_errors,
+    has_errors,
+    jaxpr_audit,
+    preflight_report,
+    shapeflow,
+)
+from deeplearning4j_tpu.analysis.findings import Finding, summarize
+from deeplearning4j_tpu.analysis.lint import lint_paths
+from deeplearning4j_tpu.analysis.lint import main as lint_main
+from deeplearning4j_tpu.nn.conf import (
+    ConvolutionLayer,
+    DenseLayer,
+    ElementWiseVertex,
+    InputType,
+    LayerVertex,
+    MergeVertex,
+    NeuralNetConfiguration,
+    OutputLayer,
+)
+from deeplearning4j_tpu.nn.conf.graph import ComputationGraphConfiguration
+from deeplearning4j_tpu.nn.conf.network import MultiLayerConfiguration
+
+
+def codes(findings):
+    return [f.code for f in findings]
+
+
+def errors(findings):
+    return [f for f in findings if f.severity == ERROR]
+
+
+# -- shapeflow: MultiLayerConfiguration --------------------------------------
+
+
+def test_nin_mismatch_yields_sf001():
+    conf = MultiLayerConfiguration(
+        layers=[DenseLayer(n_in=10, n_out=5),
+                OutputLayer(n_in=7, n_out=3)],  # 5 flows in, 7 declared
+        input_type=InputType.feed_forward(10))
+    fs = shapeflow.check_configuration(conf)
+    assert [f.code for f in errors(fs)] == ["SF001"]
+    # mapped to the offending layer, and the fix names the right number
+    assert "layer[1]" in errors(fs)[0].location
+    assert "5" in errors(fs)[0].message
+
+
+def test_unset_nout_yields_sf001():
+    conf = MultiLayerConfiguration(
+        layers=[DenseLayer(n_in=4, n_out=0),
+                OutputLayer(n_in=0, n_out=3)],
+        input_type=InputType.feed_forward(4))
+    fs = shapeflow.check_configuration(conf)
+    assert "SF001" in [f.code for f in errors(fs)]
+
+
+def test_no_inputtype_fallback_skips_conv_producers():
+    """Without an InputType, n_in can only be compared along a pure
+    dense chain: a conv's n_out is CHANNELS, so a correctly wired
+    flattened dense (n_in = h*w*c) must not be flagged."""
+    conf = MultiLayerConfiguration(
+        layers=[ConvolutionLayer(n_in=3, n_out=8),
+                DenseLayer(n_in=288, n_out=10),  # 8ch * 6x6 flattened
+                OutputLayer(n_in=10, n_out=3)])
+    fs = shapeflow.check_configuration(conf)
+    assert "SF001" not in [f.code for f in errors(fs)]
+    # but a genuinely miswired dense->dense chain still is flagged
+    conf = MultiLayerConfiguration(
+        layers=[DenseLayer(n_in=4, n_out=8),
+                OutputLayer(n_in=9, n_out=3)])
+    fs = shapeflow.check_configuration(conf)
+    assert "SF001" in [f.code for f in errors(fs)]
+
+
+def test_family_mismatch_yields_sf002():
+    # conv layer fed feed-forward input with no preprocessor
+    conf = MultiLayerConfiguration(
+        layers=[ConvolutionLayer(n_in=3, n_out=4),
+                OutputLayer(n_in=4, n_out=3)],
+        input_type=InputType.feed_forward(12))
+    fs = shapeflow.check_configuration(conf)
+    assert "SF002" in [f.code for f in errors(fs)]
+
+
+def test_missing_loss_head_yields_sf007_warning():
+    conf = MultiLayerConfiguration(
+        layers=[DenseLayer(n_in=4, n_out=2)],
+        input_type=InputType.feed_forward(4))
+    fs = shapeflow.check_configuration(conf)
+    assert not errors(fs)
+    assert "SF007" in codes(fs)
+
+
+def test_builder_built_configs_are_clean():
+    from deeplearning4j_tpu.models.charlstm import char_lstm_conf
+    from deeplearning4j_tpu.models.resnet import (
+        resnet50_conf,
+        tiny_resnet_conf,
+    )
+
+    for conf in (char_lstm_conf(), resnet50_conf(), tiny_resnet_conf()):
+        fs = shapeflow.check_configuration(conf)
+        assert not errors(fs), [f.format() for f in fs]
+        assert not fs  # clean means CLEAN: zero findings at any severity
+
+
+def test_bf16_promotion_point_is_informational():
+    from deeplearning4j_tpu.models.charlstm import char_lstm_conf
+
+    fs = shapeflow.check_configuration(char_lstm_conf(precision="bf16"))
+    assert codes(fs) == ["SF006"]
+    assert not has_errors(fs)
+
+
+# -- shapeflow: ComputationGraphConfiguration --------------------------------
+
+
+def _graph_builder(*input_types, names=("in",)):
+    gb = NeuralNetConfiguration.builder().graph_builder().add_inputs(*names)
+    if input_types:
+        gb.set_input_types(*input_types)
+    return gb
+
+
+def test_merge_fanin_conflict_yields_sf003():
+    gb = _graph_builder(InputType.convolutional(8, 8, 3),
+                        InputType.convolutional(4, 4, 3),
+                        names=("a", "b"))
+    gb.add_vertex("m", MergeVertex(), "a", "b")
+    gb.add_layer("out", OutputLayer(n_out=2), "m")
+    gb.set_outputs("out")
+    fs = shapeflow.check_configuration(gb.build())
+    sf3 = [f for f in errors(fs) if f.code == "SF003"]
+    assert sf3 and sf3[0].location == "vertex:m"
+
+
+def test_dead_vertex_yields_sf004():
+    gb = _graph_builder(InputType.feed_forward(6))
+    gb.add_layer("h", DenseLayer(n_out=4), "in")
+    gb.add_layer("side", DenseLayer(n_out=3), "in")  # feeds nothing
+    gb.add_layer("out", OutputLayer(n_out=2), "h")
+    gb.set_outputs("out")
+    fs = shapeflow.check_configuration(gb.build())
+    dead = [f for f in fs if f.code == "SF004"]
+    assert dead and dead[0].severity == WARNING
+    assert dead[0].location == "vertex:side"
+
+
+def test_cyclic_graph_yields_sf004_error():
+    conf = ComputationGraphConfiguration(
+        inputs=["in"], outputs=["out"],
+        vertices={"a": LayerVertex(layer=DenseLayer(n_in=4, n_out=4)),
+                  "out": LayerVertex(layer=OutputLayer(n_in=4, n_out=2))},
+        vertex_inputs={"a": ["a"], "out": ["a"]})
+    fs = shapeflow.check_configuration(conf)
+    assert [f.code for f in errors(fs)] == ["SF004"]
+
+
+def test_subset_out_of_channel_range_yields_sf005():
+    """SubsetVertex slices the LAST axis — channels for cnn input; a
+    bound inside h*w*c but outside the channel count is the bug."""
+    from deeplearning4j_tpu.nn.conf import SubsetVertex
+
+    gb = _graph_builder(InputType.convolutional(8, 8, 4))
+    gb.add_vertex("sub", SubsetVertex(from_=0, to=10), "in")  # 4 channels!
+    gb.add_layer("out", OutputLayer(n_out=2), "sub")
+    gb.set_outputs("out")
+    fs = shapeflow.check_configuration(gb.build())
+    assert "SF005" in [f.code for f in errors(fs)]
+
+
+def test_elementwise_shape_conflict_yields_sf005():
+    gb = _graph_builder(InputType.feed_forward(6))
+    gb.add_layer("a", DenseLayer(n_out=4), "in")
+    gb.add_layer("b", DenseLayer(n_out=5), "in")
+    gb.add_vertex("add", ElementWiseVertex(op="add"), "a", "b")
+    gb.add_layer("out", OutputLayer(n_out=2), "add")
+    gb.set_outputs("out")
+    fs = shapeflow.check_configuration(gb.build())
+    assert "SF005" in [f.code for f in errors(fs)]
+
+
+# -- jaxpr audit --------------------------------------------------------------
+
+
+def test_injected_f64_constant_yields_jx001():
+    from deeplearning4j_tpu.train.gradientcheck import enable_x64
+
+    with enable_x64():
+        c64 = np.ones(3, np.float64)
+        fs = jaxpr_audit.audit_fn(lambda x: x + c64,
+                                  np.ones(3, np.float32))
+    jx1 = [f for f in fs if f.code == "JX001"]
+    assert jx1 and jx1[0].severity == ERROR
+
+
+def test_large_folded_constant_yields_jx003():
+    big = np.ones((600, 600), np.float32)  # 1.44 MiB closure constant
+    fs = jaxpr_audit.audit_fn(lambda x: x + big,
+                              np.ones((600, 600), np.float32))
+    assert "JX003" in codes(fs)
+    # passing it as an argument instead is the fix — and is clean
+    fs = jaxpr_audit.audit_fn(lambda x, c: x + c,
+                              np.ones((600, 600), np.float32), big)
+    assert "JX003" not in codes(fs)
+
+
+def test_host_callback_yields_jx004():
+    import jax
+
+    def fn(x):
+        jax.debug.print("x={x}", x=x)
+        return x * 2.0
+
+    fs = jaxpr_audit.audit_fn(fn, np.ones(3, np.float32))
+    assert "JX004" in codes(fs)
+
+
+def test_dead_input_yields_jx005():
+    fs = jaxpr_audit.audit_fn(lambda a, b: a * 2.0,
+                              np.ones(3, np.float32),
+                              np.ones(3, np.float32))
+    jx5 = [f for f in fs if f.code == "JX005"]
+    assert len(jx5) == 1 and "arg[1]" in jx5[0].name
+
+
+def test_dead_param_in_graph_yields_jx005():
+    """A dead vertex's weights have no cotangent path — the auditor
+    names the vertex and the param."""
+    from deeplearning4j_tpu.nn.compgraph import ComputationGraph
+
+    gb = _graph_builder(InputType.feed_forward(6))
+    gb.add_layer("h", DenseLayer(n_out=4), "in")
+    gb.add_layer("side", DenseLayer(n_out=3), "in")
+    gb.add_layer("out", OutputLayer(n_out=2), "h")
+    gb.set_outputs("out")
+    net = ComputationGraph(gb.build()).init()
+    fs = jaxpr_audit.audit_network(net)
+    assert sorted(f.name for f in fs if f.code == "JX005") == [
+        "JX005:param:side/W", "JX005:param:side/b"]
+
+
+def test_clean_networks_audit_clean():
+    from deeplearning4j_tpu.models.charlstm import char_lstm_network
+    from deeplearning4j_tpu.models.resnet import tiny_resnet_conf
+    from deeplearning4j_tpu.nn.compgraph import ComputationGraph
+
+    lstm = char_lstm_network(vocab_size=11, hidden=8, layers=1)
+    assert jaxpr_audit.audit_network(lstm, timesteps=6) == []
+    tiny = ComputationGraph(tiny_resnet_conf()).init()
+    assert jaxpr_audit.audit_network(tiny) == []
+    # net.doctor() = shapeflow + audit, end to end
+    assert lstm.doctor(timesteps=6) == []
+
+
+def test_donation_check():
+    assert jaxpr_audit.check_donation((0, 2), backend="tpu") == []
+    assert jaxpr_audit.check_donation((), backend="cpu") == []
+    fs = jaxpr_audit.check_donation((), backend="tpu")
+    assert [f.code for f in fs] == ["JX006"]
+
+
+# -- concurrency lint ---------------------------------------------------------
+
+
+_BAD_MODULE = textwrap.dedent("""\
+    import queue
+    import threading
+
+    q = queue.Queue(maxsize=2)
+
+
+    def worker():
+        while True:
+            try:
+                item = q.get()
+            except:
+                pass
+            print(item)
+
+
+    def start():
+        t = threading.Thread(target=worker)
+        t.start()
+        q.put(1)
+
+
+    class A:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._stats_lock = threading.Lock()
+
+        def f(self):
+            with self._lock:
+                with self._stats_lock:
+                    pass
+
+        def g(self):
+            with self._stats_lock:
+                with self._lock:
+                    pass
+    """)
+
+
+@pytest.fixture
+def bad_module(tmp_path):
+    p = tmp_path / "badmod.py"
+    p.write_text(_BAD_MODULE)
+    return p
+
+
+def test_lint_flags_every_code_once(bad_module):
+    fs = lint_paths([str(bad_module)], base_dir=str(bad_module.parent))
+    got = sorted(set(codes(fs)))
+    assert got == ["CC001", "CC002", "CC003", "CC004", "CC005", "CC006"]
+    # stable names: scope-qualified, no line numbers
+    names = {f.name for f in fs}
+    assert "CC001:badmod.py:worker" in names
+    assert "CC002:badmod.py:start" in names  # the timeout-less q.put(1)
+    assert any(n.startswith("CC005:") for n in names)
+
+
+def test_lint_accepts_the_sanctioned_shapes(tmp_path):
+    p = tmp_path / "goodmod.py"
+    p.write_text(textwrap.dedent("""\
+        import queue
+        import threading
+
+        from deeplearning4j_tpu.utils.concurrency import (
+            get_abortable,
+            put_abortable,
+        )
+
+        q = queue.Queue(maxsize=2)
+        stop = threading.Event()
+
+
+        def worker():
+            while True:
+                try:
+                    item = get_abortable(q, stop)
+                except Exception:
+                    return
+                q.put(item, timeout=0.5)
+
+
+        def start():
+            t = threading.Thread(target=worker, daemon=True,
+                                 name="dl4j-test-worker")
+            t.start()
+            put_abortable(q, 1, stop)
+            q.put_nowait(2)
+            q.put(3, block=False)  # cannot wedge: raises Full immediately
+        """))
+    assert lint_paths([str(p)], base_dir=str(tmp_path)) == []
+
+
+def test_lint_str_join_does_not_mask_cc004(tmp_path):
+    """str.join in the same function must not count as joining the
+    thread — only thread-ish receivers satisfy CC004."""
+    p = tmp_path / "joiner.py"
+    p.write_text(textwrap.dedent("""\
+        import threading
+
+
+        def start(names):
+            label = ",".join(names)
+            t = threading.Thread(target=print, name="dl4j-x-" + label)
+            t.start()
+        """))
+    fs = lint_paths([str(p)], base_dir=str(tmp_path))
+    assert "CC004" in codes(fs)
+    # a real join of the thread variable satisfies it
+    p.write_text(textwrap.dedent("""\
+        import threading
+
+
+        def start(names):
+            label = ",".join(names)
+            t = threading.Thread(target=print, name="dl4j-x-" + label)
+            t.start()
+            t.join()
+        """))
+    assert "CC004" not in codes(lint_paths([str(p)],
+                                           base_dir=str(tmp_path)))
+
+
+def test_lint_positional_block_forms(tmp_path):
+    """q.put(item, True) blocks with no timeout -> CC002; q.get(False)
+    cannot block -> clean."""
+    p = tmp_path / "posargs.py"
+    p.write_text(textwrap.dedent("""\
+        import queue
+        import threading
+
+        q = queue.Queue(maxsize=2)
+
+
+        def f():
+            q.put(1, True)
+
+
+        def g():
+            return q.get(False)
+        """))
+    fs = lint_paths([str(p)], base_dir=str(tmp_path))
+    names = {f.name for f in fs if f.code == "CC002"}
+    assert names == {"CC002:posargs.py:f"}
+
+
+def test_lint_lock_order_cycle_needs_conflicting_orders(tmp_path):
+    # consistent ordering across call sites: edges, but no cycle
+    p = tmp_path / "ordered.py"
+    p.write_text(textwrap.dedent("""\
+        import threading
+
+        a = threading.Lock()
+        b_lock = threading.Lock()
+
+
+        def f():
+            with a:
+                with b_lock:
+                    pass
+
+
+        def g():
+            with a:
+                with b_lock:
+                    pass
+        """))
+    assert "CC005" not in codes(lint_paths([str(p)],
+                                           base_dir=str(tmp_path)))
+
+
+def test_committed_tree_is_lint_clean_modulo_baseline():
+    """THE gate invariant scripts/lint.sh enforces in t1: no ERROR
+    finding outside scripts/lint_baseline.txt on the committed tree."""
+    import os
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    fs = lint_paths([os.path.join(root, "deeplearning4j_tpu"),
+                     os.path.join(root, "bench.py")], base_dir=root)
+    with open(os.path.join(root, "scripts", "lint_baseline.txt")) as f:
+        allowed = {ln.strip() for ln in f
+                   if ln.strip() and not ln.startswith("#")}
+    new = [f.name for f in errors(fs) if f.name not in allowed]
+    assert not new, f"lint regressions vs scripts/lint_baseline.txt: {new}"
+
+
+def test_lint_main_baseline_gate(bad_module, tmp_path):
+    """Introducing a bare except / timeout-less put fails the gate
+    (exit 1); the committed baseline keeps the committed tree green."""
+    baseline = tmp_path / "baseline.txt"
+    baseline.write_text("# nothing grandfathered\n")
+    rc = lint_main(["--quiet", "--baseline", str(baseline),
+                    str(bad_module)])
+    assert rc == 1
+    # grandfathering exactly today's names turns the same tree green
+    fs = lint_paths([str(bad_module)], base_dir=str(bad_module.parent))
+    names = sorted({f.name for f in errors(fs)})
+    # names are relative to CWD in main(); regenerate from there
+    fs_cwd = lint_paths([str(bad_module)])
+    baseline.write_text("".join(
+        sorted(f.name + "\n" for f in errors(fs_cwd))))
+    assert names  # sanity: the fixture does produce errors
+    rc = lint_main(["--quiet", "--baseline", str(baseline),
+                    str(bad_module)])
+    assert rc == 0
+
+
+# -- doctor / CLI / bench wiring ----------------------------------------------
+
+
+def test_doctor_never_raises_on_warning_grade_configs():
+    """A config whose only defect is warning-grade (no loss head) makes
+    the loss trace fail — the doctor must report that as a finding, not
+    crash (the no-raise contract)."""
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    conf = (NeuralNetConfiguration.builder().list()
+            .layer(DenseLayer(n_out=4))
+            .set_input_type(InputType.feed_forward(3))
+            .build())
+    fs = MultiLayerNetwork(conf).init().doctor()
+    assert "SF007" in codes(fs)
+    assert "JX000" in codes(fs)  # trace failure surfaced as a finding
+    assert not has_errors(fs)
+
+
+def test_cli_doctor_clean_presets(capsys):
+    from deeplearning4j_tpu.cli import main as cli_main
+
+    rc = cli_main(["doctor", "--preset", "tiny_resnet", "--json", "-"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0 and out["ok"] and out["errors"] == 0
+    # resnet50 topology itself (config pass; small image keeps init cheap)
+    rc = cli_main(["doctor", "--preset", "resnet50", "--image-size", "32",
+                   "--classes", "10", "--no-jaxpr"])
+    assert rc == 0
+
+
+def test_cli_doctor_charlstm_clean():
+    from deeplearning4j_tpu.cli import main as cli_main
+
+    assert cli_main(["doctor", "--preset", "charlstm"]) == 0
+
+
+def test_cli_lint_exits_nonzero_on_errors(bad_module, capsys):
+    from deeplearning4j_tpu.cli import main as cli_main
+
+    rc = cli_main(["lint", "--json", "-", str(bad_module)])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1 and not out["ok"] and out["errors"] > 0
+
+
+def test_bench_refuses_to_headline_broken_model():
+    from bench import _doctor_refusal
+
+    broken = MultiLayerConfiguration(
+        layers=[DenseLayer(n_in=10, n_out=5),
+                OutputLayer(n_in=7, n_out=3)],
+        input_type=InputType.feed_forward(10))
+    refusal = _doctor_refusal(broken, "images/sec/chip")
+    assert refusal is not None
+    assert refusal["value"] is None
+    assert any("SF001" in e for e in refusal["doctor_errors"])
+
+    from deeplearning4j_tpu.models.charlstm import char_lstm_conf
+
+    assert _doctor_refusal(char_lstm_conf(), "tokens/sec/chip") is None
+
+
+def test_doctor_errors_and_preflight_report():
+    broken = MultiLayerConfiguration(
+        layers=[DenseLayer(n_in=10, n_out=5),
+                OutputLayer(n_in=7, n_out=3)],
+        input_type=InputType.feed_forward(10))
+    errs = doctor_errors(broken)
+    assert [f.code for f in errs] == ["SF001"]
+    # preflight logs and returns, never raises — even on garbage
+    assert preflight_report(broken, origin="test.zip")
+    assert preflight_report(object(), origin="junk") == []
+
+
+def test_import_preflight_rides_the_dl4j_import_path(tmp_path):
+    """The dl4j model-import path attaches the free pre-flight report."""
+    from deeplearning4j_tpu.modelimport.dl4j import (
+        export_dl4j_zip,
+        import_dl4j_multilayer,
+    )
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    conf = (NeuralNetConfiguration.builder().seed(7).list()
+            .layer(DenseLayer(n_out=5, activation="tanh"))
+            .layer(OutputLayer(n_out=3, activation="softmax"))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    path = str(tmp_path / "m.zip")
+    export_dl4j_zip(net, path)
+    imported = import_dl4j_multilayer(path)
+    assert imported.import_preflight == []  # clean model, clean report
+
+
+def test_findings_summarize_and_name_stability():
+    f = Finding("SF001", ERROR, "layer[1]:out", "boom")
+    assert f.name == "SF001:layer[1]:out"
+    s = summarize([f])
+    assert s["errors"] == 1 and not s["ok"]
+    assert s["findings"][0]["code"] == "SF001"
