@@ -262,10 +262,14 @@ def cmd_metrics(args) -> int:
     running server (inference-server /metrics; any endpoint speaking the
     same routes); without it, dump THIS process's registry — useful from
     scripts that embed training/serving in-process (bench.py does the
-    same thing per workload)."""
+    same thing per workload). --watch <secs> re-scrapes on that period
+    and prints counter/histogram DELTAS plus gauge values, so health and
+    stall series are observable live without a Prometheus stack."""
     import json as _json
     import urllib.request
 
+    if args.watch is not None:
+        return _metrics_watch(args)
     if args.url:
         url = args.url.rstrip("/") + "/metrics"
         if args.format == "prometheus":
@@ -284,6 +288,87 @@ def cmd_metrics(args) -> int:
         print(f"wrote {args.output}")
     else:
         print(text)
+    return 0
+
+
+def _scrape_scalars(url, timeout: float) -> dict:
+    """One flat {series: value} sample — from a server's JSON /metrics
+    snapshot, or the local process registry when url is None."""
+    from deeplearning4j_tpu.utils.metrics import get_registry
+
+    if url is None:
+        return get_registry().scalar_values()
+    import json as _json
+    import urllib.request
+
+    with urllib.request.urlopen(url.rstrip("/") + "/metrics?format=registry",
+                                timeout=timeout) as r:
+        snap = _json.loads(r.read().decode())
+    out = {}
+    for name, fam in snap.items():
+        for v in fam.get("values", []):
+            labels = v.get("labels") or {}
+            lab = ("{" + ",".join(f'{k}="{labels[k]}"'
+                                  for k in sorted(labels)) + "}"
+                   if labels else "")
+            if fam.get("type") == "histogram":
+                out[f"{name}{lab}:count"] = float(v.get("count", 0))
+                out[f"{name}{lab}:sum"] = float(v.get("sum", 0.0))
+            elif v.get("value") is not None:
+                out[f"{name}{lab}"] = float(v["value"])
+    return out
+
+
+def _metrics_watch(args) -> int:
+    """Periodic re-scrape: counters and histogram counts print as deltas
+    per tick, gauges as current values. Ctrl-C (or --watch-count) ends."""
+    import time as _time
+
+    period = max(0.05, float(args.watch))
+    prev = _scrape_scalars(args.url, args.timeout)
+    ticks = 0
+    try:
+        while args.watch_count <= 0 or ticks < args.watch_count:
+            _time.sleep(period)
+            now = _scrape_scalars(args.url, args.timeout)
+            ticks += 1
+            stamp = _time.strftime("%H:%M:%S")
+            print(f"-- {stamp} (every {period:g}s, tick {ticks}) --")
+            for key in sorted(now):
+                v = now[key]
+                is_rate = key.endswith((":count", ":sum")) \
+                    or key.split("{")[0].endswith("_total")
+                if is_rate:
+                    dv = v - prev.get(key, 0.0)
+                    if dv:
+                        print(f"  {key}  +{dv:g}  (total {v:g})")
+                elif v != prev.get(key):
+                    print(f"  {key}  {v:g}")
+            prev = now
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+def cmd_blackbox(args) -> int:
+    """Render a flight-recorder crash dump (utils/blackbox — written by
+    install_crash_hooks on SIGTERM/fatal error, by the watchdog on a
+    hang, or on demand): the final-steps timeline, events, component
+    health, and every thread's stack at dump time."""
+    import json as _json
+    import os
+
+    from deeplearning4j_tpu.utils.blackbox import render_dump
+
+    if not os.path.exists(args.dump):
+        print(f"dump not found: {args.dump}", file=sys.stderr)
+        return 2
+    with open(args.dump) as f:
+        doc = _json.load(f)
+    if args.json:
+        print(_json.dumps(doc, indent=2, default=str))
+    else:
+        print(render_dump(doc, max_steps=args.steps))
     return 0
 
 
@@ -450,7 +535,24 @@ def main(argv=None) -> int:
     m.add_argument("--output", default=None,
                    help="write to this file instead of stdout")
     m.add_argument("--timeout", type=float, default=10.0)
+    m.add_argument("--watch", type=float, default=None, metavar="SECS",
+                   help="re-scrape every SECS seconds, printing counter "
+                        "deltas and gauge values (ctrl-C to stop)")
+    m.add_argument("--watch-count", type=int, default=0,
+                   help="stop after N watch ticks (0 = until ctrl-C)")
     m.set_defaults(fn=cmd_metrics)
+
+    bb = sub.add_parser(
+        "blackbox",
+        help="render a flight-recorder crash dump (final-steps timeline, "
+             "events, component health, thread stacks)")
+    bb.add_argument("dump", help="path to a blackbox JSON dump "
+                                 "(utils/blackbox.install_crash_hooks)")
+    bb.add_argument("--steps", type=int, default=32,
+                    help="how many of the final steps to render")
+    bb.add_argument("--json", action="store_true",
+                    help="pretty-print the raw dump instead of rendering")
+    bb.set_defaults(fn=cmd_blackbox)
 
     d = sub.add_parser(
         "doctor",

@@ -48,6 +48,7 @@ from deeplearning4j_tpu.data.iterators import (
     _get_abortable,
     _put_abortable,
 )
+from deeplearning4j_tpu.utils import health as _health
 from deeplearning4j_tpu.utils import metrics as _metrics
 
 _DONE = object()  # one per ETL worker: "this worker's stream is finished"
@@ -153,13 +154,16 @@ class ParallelDataSetIterator(DataSetIterator):
 
     def __init__(self, base, transform: Optional[Callable] = None,
                  workers: int = 2, queue_size: Optional[int] = None,
-                 ordered: bool = True, stage: str = "etl"):
+                 ordered: bool = True, stage: str = "etl",
+                 health_stall_after: float = 120.0):
         self.base = base
         self.transform = transform
         self.workers = max(1, int(workers))
         self.queue_size = max(self.workers, int(queue_size)
                               if queue_size is not None else 2 * self.workers)
         self.ordered = ordered
+        self.stage = stage
+        self.health_stall_after = health_stall_after
         self._ins = _stage_instruments(stage)
         self._active: List[tuple] = []
 
@@ -170,25 +174,41 @@ class ParallelDataSetIterator(DataSetIterator):
         q: "queue.Queue" = queue.Queue(maxsize=self.queue_size)
         stop = threading.Event()
         ins = self._ins
+        # ONE heartbeat shared by all workers: each holds a busy slot
+        # while it owns an item (base pull + transform); the component
+        # stalls when the OLDEST slot goes stale, so one wedged worker
+        # is not masked by its siblings (utils/health)
+        hb = _health.get_health().register(
+            f"pipeline_{self.stage}", stall_after=self.health_stall_after)
 
         def worker():
             while not stop.is_set():
                 seq = None
                 try:
+                    # busy only INSIDE the lock: a worker queued on
+                    # src_lock behind a slow-but-progressing base is
+                    # idle, not stalled — only the thread actually
+                    # pulling (a wedged base) or transforming owes
+                    # progress
                     with src_lock:
-                        try:
-                            item = next(src)
-                        except StopIteration:
-                            return
-                        seq = seq_box[0]
-                        seq_box[0] += 1
-                    out = self.transform(item) if self.transform else item
+                        with hb.busy():
+                            try:
+                                item = next(src)
+                            except StopIteration:
+                                return
+                            seq = seq_box[0]
+                            seq_box[0] += 1
+                    with hb.busy():
+                        out = (self.transform(item) if self.transform
+                               else item)
                 except BaseException as e:
                     # seq None: the BASE iterator raised — deliver
                     # immediately (every worker will hit it; first wins)
                     _put_abortable(q, (-1 if seq is None else seq, e, None),
                                    stop)
                     return
+                # the put is NOT busy time: a full queue means the
+                # consumer is slow, which is the consumer's stall to own
                 t0 = time.perf_counter()
                 if not _put_abortable(q, (seq, None, out), stop):
                     return
@@ -219,6 +239,7 @@ class ParallelDataSetIterator(DataSetIterator):
             yield from self._reassemble(q, stop, ins)
         finally:
             _close_run(q, stop, threads)
+            _health.get_health().unregister(hb)
             if run in self._active:
                 self._active.remove(run)
 
@@ -302,13 +323,16 @@ class DevicePrefetchIterator(DataSetIterator):
                  placement=None, device=None,
                  transform: Optional[Callable] = None,
                  close_base: bool = False,
-                 stage: str = "device_prefetch"):
+                 stage: str = "device_prefetch",
+                 health_stall_after: float = 120.0):
         self.base = base
         self.depth = max(1, int(depth))
         self.placement = placement
         self.device = device
         self.transform = transform
         self.close_base = close_base
+        self.stage = stage
+        self.health_stall_after = health_stall_after
         self._ins = _stage_instruments(stage)
         self._active: List[tuple] = []
         self._sentinel = object()
@@ -345,11 +369,24 @@ class DevicePrefetchIterator(DataSetIterator):
         sentinel = self._sentinel
         target = self._resolve_target()
 
+        # liveness: busy while an item is in hand (base pull + staging —
+        # a wedged upstream iterator or a device_put that never returns
+        # goes stale); the backpressured put stays outside busy (a full
+        # queue is the fit loop's slowness, tracked by ITS heartbeat)
+        hb = _health.get_health().register(
+            self.stage, stall_after=self.health_stall_after)
+
         def worker():
             try:
-                for ds in self.base:
-                    nb = _ds_nbytes(ds)  # host bytes, before staging
-                    staged = self._stage(ds, target)
+                it = iter(self.base)
+                while True:
+                    with hb.busy():
+                        try:
+                            ds = next(it)
+                        except StopIteration:
+                            return
+                        nb = _ds_nbytes(ds)  # host bytes, before staging
+                        staged = self._stage(ds, target)
                     t0 = time.perf_counter()
                     if not _put_abortable(q, staged, stop):
                         return
@@ -379,6 +416,7 @@ class DevicePrefetchIterator(DataSetIterator):
                 yield item
         finally:
             _close_run(q, stop, [t])
+            _health.get_health().unregister(hb)
             if run in self._active:
                 self._active.remove(run)
 

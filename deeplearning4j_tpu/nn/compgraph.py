@@ -418,12 +418,18 @@ class ComputationGraph(NetworkBase):
     # -- fit -----------------------------------------------------------------
 
     def fit(self, data, labels=None, *, epochs: int = 1, batch_size: int = 32,
-            async_prefetch: bool = True, prefetch_buffer: int = 4):
+            async_prefetch: bool = True, prefetch_buffer: int = 4,
+            hang_timeout: float = None):
         """Train. Accepts (features, labels) arrays, a DataSet/MultiDataSet,
         or a DataSetIterator/MultiDataSetIterator (reference:
         ComputationGraph.fit overloads :857-867). With async_prefetch the
         staged input pipeline (nn/netbase._stage_input_pipeline) feeds the
-        loop; prefetch_buffer is the host stage's queue depth."""
+        loop; prefetch_buffer is the host stage's queue depth.
+        `hang_timeout` (seconds) arms the hang watchdog: a stalled step
+        raises utils.health.StepHangError with a flight-recorder dump
+        path instead of blocking forever — pick it above the worst-case
+        single phase (first-step trace+compile, longest legitimate data
+        wait)."""
         self._require_init()
         if isinstance(data, (DataSetIterator, MultiDataSetIterator)):
             iterator = data
@@ -436,7 +442,7 @@ class ComputationGraph(NetworkBase):
                 DataSet(np.asarray(data), np.asarray(labels)), batch_size
             )
         return self._run_fit(iterator, epochs, async_prefetch,
-                             prefetch_buffer)
+                             prefetch_buffer, hang_timeout=hang_timeout)
 
     def _fit_dataset(self, ds):
         mds = _as_multidataset(ds)

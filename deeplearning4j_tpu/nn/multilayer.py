@@ -606,7 +606,8 @@ class MultiLayerNetwork(NetworkBase):
     # -- fit -----------------------------------------------------------------
 
     def fit(self, data, labels=None, *, epochs: int = 1, batch_size: int = 32,
-            async_prefetch: bool = True, prefetch_buffer: int = 4):
+            async_prefetch: bool = True, prefetch_buffer: int = 4,
+            hang_timeout: float = None):
         """Train. Accepts (features, labels) arrays, a DataSet, or a
         DataSetIterator (reference: MultiLayerNetwork.fit overloads
         :1019). If the configuration sets pretrain=True, layerwise
@@ -614,14 +615,19 @@ class MultiLayerNetwork(NetworkBase):
         (reference: fit() pretrain dispatch :210). With async_prefetch the
         staged input pipeline (host ETL thread -> device prefetch, see
         nn/netbase._stage_input_pipeline) feeds the loop; prefetch_buffer
-        is the host stage's queue depth."""
+        is the host stage's queue depth. `hang_timeout` (seconds) arms the
+        hang watchdog: a step making no progress for that long raises
+        utils.health.StepHangError carrying a flight-recorder dump path
+        instead of blocking forever. Pick it above the worst-case single
+        phase — the first step's trace+compile and the longest legitimate
+        data wait both count as "no progress" if they exceed it."""
         self._require_init()
         if self.conf.pretrain and not getattr(self, "_pretrained", False):
             self.pretrain(data, batch_size=batch_size)
             self._pretrained = True
         iterator = self._as_iterator(data, labels, batch_size)
         return self._run_fit(iterator, epochs, async_prefetch,
-                             prefetch_buffer)
+                             prefetch_buffer, hang_timeout=hang_timeout)
 
     def _as_iterator(self, data, labels, batch_size) -> DataSetIterator:
         if isinstance(data, DataSetIterator):

@@ -11,6 +11,7 @@ MultiLayerNetwork.java:102-104 flattenedParams).
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from typing import List, Optional
@@ -24,8 +25,12 @@ from deeplearning4j_tpu.nn.params import (
     param_table,
     params_to_flat,
 )
+from deeplearning4j_tpu.utils import blackbox as _blackbox
+from deeplearning4j_tpu.utils import health as _health
 from deeplearning4j_tpu.utils import metrics as _metrics
 from deeplearning4j_tpu.utils import tracing as _tracing
+
+logger = logging.getLogger("deeplearning4j_tpu")
 
 
 class NetworkBase:
@@ -76,6 +81,13 @@ class NetworkBase:
         # _step_donate_argnums) — the doctor's JX006 check audits THIS,
         # not a reconstruction of the policy
         self._donate_argnums = None
+        # the watchdog heartbeat of the CURRENT fit (utils/health) — set
+        # for the duration of _run_fit; the step path beats it
+        self._fit_heartbeat = None
+        # where the hang action dumped the flight recorder before raising
+        # StepHangError into the fit thread (read when enriching the
+        # async-raised bare exception)
+        self._hang_dump_path = None
 
     # -- to be provided by subclasses ----------------------------------------
 
@@ -128,6 +140,9 @@ class NetworkBase:
             ("kind",)).labels(kind).inc()
         _tracing.instant("compile", kind=kind,
                          key=None if key is None else str(key))
+        _blackbox.get_recorder().record_event(
+            "compile", compile_kind=kind,
+            key=None if key is None else str(key))
 
     def _step_donate_argnums(self):
         """donate_argnums for jitted optimizer steps: params (0) and
@@ -300,6 +315,7 @@ class NetworkBase:
                     "fit batches whose example count could not be "
                     "determined (excluded from fit_examples_total — "
                     "an under-report made explicit, not silent)").labels(),
+                "recorder": _blackbox.get_recorder(),
             }
         return ins
 
@@ -312,6 +328,13 @@ class NetworkBase:
         change the async dispatch pipeline it observes."""
         ins = self._fit_obs()
         it0 = self.iteration
+        sync = None
+        # beat on entry AND exit: each phase (data wait, dispatch) must
+        # individually exceed hang_timeout to read as a stall, instead of
+        # their sum tripping the watchdog on an input-bound step
+        hb0 = self._fit_heartbeat
+        if hb0 is not None:
+            hb0.beat()
         t0 = time.perf_counter()
         with _tracing.span("fit/step", data_wait_ms=round(data_wait * 1e3, 3)):
             with _tracing.span("fit/dispatch"):
@@ -323,11 +346,20 @@ class NetworkBase:
                 t1 = time.perf_counter()
                 with _tracing.span("fit/device_sync"):
                     jax.block_until_ready(self._score)
-                ins["sync"].observe(time.perf_counter() - t1)
+                sync = time.perf_counter() - t1
+                ins["sync"].observe(sync)
         ins["steps"].inc(max(1, self.iteration - it0))
         ins["examples"].inc(n_examples)
         ins["data_wait"].observe(data_wait)
         ins["dispatch"].observe(dispatch)
+        # black box + liveness: one ring append (score kept as a device
+        # reference — never synced here) and a heartbeat refresh
+        ins["recorder"].record_step(self.iteration - 1, score=self._score,
+                                    data_wait=data_wait, dispatch=dispatch,
+                                    sync=sync)
+        hb = self._fit_heartbeat
+        if hb is not None:
+            hb.beat()
 
     def _ds_examples(self, ds) -> int:
         """Example count for `fit_examples_total`. Only structural
@@ -346,7 +378,8 @@ class NetworkBase:
     # -- the fit loop --------------------------------------------------------
 
     def _run_fit(self, iterator, epochs: int, async_prefetch: bool,
-                 prefetch_buffer: int = 4):
+                 prefetch_buffer: int = 4,
+                 hang_timeout: Optional[float] = None):
         owned = None
         if async_prefetch:
             staged = self._stage_input_pipeline(iterator, prefetch_buffer)
@@ -359,9 +392,29 @@ class NetworkBase:
             and self._batch_transform is None
             and self._fused_fit_supported()
         ) else 1
+        # liveness: the fit thread holds a busy slot on the "fit"
+        # heartbeat for the whole run and beats once per dispatch
+        # (_timed_fit). With hang_timeout the watchdog's stall action
+        # dumps the flight recorder and raises StepHangError here —
+        # a wedged step becomes a diagnosable exception, not a hang.
+        hb = _health.get_health().register(
+            "fit",
+            stall_after=hang_timeout if hang_timeout else 600.0,
+            on_stall=self._hang_action() if hang_timeout else None)
+        self._fit_heartbeat = hb
         try:
-            self._fit_epochs(iterator, epochs, fuse_k)
+            with hb.busy():
+                self._fit_epochs(iterator, epochs, fuse_k)
+        except _health.StepHangError as e:
+            if e.dump_path is not None:
+                raise  # already carries its forensics
+            raise _health.StepHangError(
+                f"fit step exceeded hang_timeout={hang_timeout}s without "
+                f"progress (see flight-recorder dump)",
+                dump_path=self._hang_dump_path) from None
         finally:
+            self._fit_heartbeat = None
+            _health.get_health().unregister(hb)
             # pipeline workers this fit created must die with it, raise
             # or return (the generators' own finally handles the common
             # case; this covers anything still live after an exception)
@@ -375,6 +428,33 @@ class NetworkBase:
                 if hook is not None:
                     hook(self)
         return self
+
+    def _hang_action(self):
+        """The watchdog-side stall action for fit(hang_timeout=...):
+        runs on the dl4j-watchdog thread — dump the black box first (the
+        forensics must exist before the exception unwinds the fit), then
+        async-raise StepHangError into the fitting thread."""
+        fit_tid = threading.get_ident()
+
+        def on_stall(hb, stalled_for):
+            self._hang_dump_path = _blackbox.get_recorder().dump(
+                reason=f"fit step hang: no progress for "
+                       f"{stalled_for:.3f}s (hang_timeout={hb.stall_after}s)")
+            # the dump takes real time: re-check the fit is still OURS
+            # and still stalled before the irrevocable async raise — a
+            # step that unblocked (or a fit that finished) meanwhile must
+            # not receive a StepHangError in its cleanup or afterwards
+            if self._fit_heartbeat is not hb:
+                return
+            state, _, _ = hb.check()
+            if state == _health.OK:
+                return
+            if not _health._async_raise(fit_tid, _health.StepHangError):
+                logger.error(
+                    "fit hang detected but StepHangError could not be "
+                    "delivered; dump at %s", self._hang_dump_path)
+
+        return on_stall
 
     def _stage_input_pipeline(self, iterator, prefetch_buffer: int):
         """Compose the staged input pipeline around a fit's iterator:

@@ -36,6 +36,15 @@ def _count(metric: str, op: str, helper: str, reason: Optional[str] = None):
         reg.counter(metric, "Helper SPI events",
                     ("op", "helper", "reason")).labels(op, helper,
                                                        reason).inc()
+    if metric != "helper_hit_total":
+        # fallbacks and auto-disables are rare, diagnosis-relevant events
+        # — they ride in the flight recorder so a crash dump shows the
+        # kernel story leading up to the failure (hits would be noise)
+        from deeplearning4j_tpu.utils import blackbox as _blackbox
+
+        _blackbox.get_recorder().record_event(
+            metric.replace("_total", ""), op=op, helper=helper,
+            **({"reason": reason} if reason else {}))
 
 
 class HelperError(RuntimeError):

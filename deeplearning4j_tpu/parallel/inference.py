@@ -51,6 +51,7 @@ from deeplearning4j_tpu.parallel.mesh import (
     pad_wrap,
     replicated,
 )
+from deeplearning4j_tpu.utils import health as _health
 from deeplearning4j_tpu.utils import metrics as _metrics
 from deeplearning4j_tpu.utils import tracing as _tracing
 from deeplearning4j_tpu.utils.concurrency import (
@@ -99,6 +100,7 @@ class ParallelInference:
         batch_timeout_ms: float = 2.0,
         buckets: Optional[Sequence[int]] = None,
         handoff_capacity: int = 2,
+        health_stall_after: float = 30.0,
     ):
         self.model = model
         self.mesh = mesh if mesh is not None else data_parallel_mesh()
@@ -178,7 +180,20 @@ class ParallelInference:
         ).set_function(lambda: _queue_depth(ref))
         self._collect_t: Optional[threading.Thread] = None
         self._dispatch_t: Optional[threading.Thread] = None
+        # liveness (utils/health): each pipeline stage holds a busy slot
+        # only while it OWNS work — waiting on an empty request queue is
+        # idle, but a dispatcher wedged inside a device forward (or a
+        # collector blocked handing off to a dead device) goes stale and
+        # the watchdog flips `component_health{component=...}`. GET
+        # /health on the serving layer aggregates exactly this.
+        self._hb_collect: Optional[_health.Heartbeat] = None
+        self._hb_dispatch: Optional[_health.Heartbeat] = None
         if self.mode == InferenceMode.BATCHED:
+            hreg = _health.get_health()
+            self._hb_collect = hreg.register(
+                "serving_collector", stall_after=health_stall_after)
+            self._hb_dispatch = hreg.register(
+                "serving_dispatcher", stall_after=health_stall_after)
             self._collect_t = threading.Thread(
                 target=self._collector, daemon=True,
                 name="dl4j-serving-collector")
@@ -297,6 +312,9 @@ class ParallelInference:
             self._dispatch_t.join(timeout=10)
             workers_exited = (not self._collect_t.is_alive()
                               and not self._dispatch_t.is_alive())
+        for hb in (self._hb_collect, self._hb_dispatch):
+            if hb is not None:
+                _health.get_health().unregister(hb)
         if not workers_exited:
             # a slow in-flight forward (e.g. first compile) outlived the
             # join timeout: the pipeline is still draining and will resolve
@@ -416,42 +434,50 @@ class ParallelInference:
     # BATCHED pipeline, stage 1: drain + concatenate + pad on the host
     def _collector(self):
         pending = None  # request that would overflow the current group
+        hb = self._hb_collect
         while True:
             if pending is not None:
                 item, pending = pending, None
             else:
                 # poll-loop get (no abort predicate: the shutdown
                 # sentinel is the exit protocol — it must drain the queue
-                # in order, so the collector never exits ahead of it)
+                # in order, so the collector never exits ahead of it).
+                # No busy slot while waiting here: an EMPTY request queue
+                # is idle, not a stall.
                 item = get_abortable(self._q)
             if item is None:
                 self._put_handoff(None)
                 return
-            group = [item]
-            count = item[0].shape[0]
-            # drain more requests until the batch limit or a short timeout
-            while count < self.max_batch_size:
-                try:
-                    nxt = self._q.get(timeout=self.batch_timeout)
-                except queue.Empty:
-                    break
-                if nxt is None:
-                    self._emit(group)
-                    self._put_handoff(None)
-                    return
-                if (count + nxt[0].shape[0] > self.max_batch_size
-                        or nxt[0].shape[1:] != item[0].shape[1:]):
-                    # would overflow max_batch_size (and possibly fall off
-                    # the bucket set) — or, during an unpin/re-pin window
-                    # before the first successful forward, has a different
-                    # feature shape (admission normally guarantees
-                    # uniformity; this makes mixed-shape fusion
-                    # structurally impossible) — start the next group
-                    pending = nxt
-                    break
-                group.append(nxt)
-                count += nxt[0].shape[0]
-            self._emit(group)
+            # work in hand: from here until the handoff completes this
+            # thread owes progress (a block inside _emit's handoff put
+            # means the device is wedged — exactly what should degrade)
+            with hb.busy():
+                group = [item]
+                count = item[0].shape[0]
+                # drain more requests until batch limit or short timeout
+                while count < self.max_batch_size:
+                    try:
+                        nxt = self._q.get(timeout=self.batch_timeout)
+                    except queue.Empty:
+                        break
+                    if nxt is None:
+                        self._emit(group)
+                        self._put_handoff(None)
+                        return
+                    if (count + nxt[0].shape[0] > self.max_batch_size
+                            or nxt[0].shape[1:] != item[0].shape[1:]):
+                        # would overflow max_batch_size (and possibly fall
+                        # off the bucket set) — or, during an unpin/re-pin
+                        # window before the first successful forward, has
+                        # a different feature shape (admission normally
+                        # guarantees uniformity; this makes mixed-shape
+                        # fusion structurally impossible) — start the
+                        # next group
+                        pending = nxt
+                        break
+                    group.append(nxt)
+                    count += nxt[0].shape[0]
+                self._emit(group)
 
     def _emit(self, group):
         """Host-side batch assembly; blocks on the bounded handoff queue
@@ -488,14 +514,18 @@ class ParallelInference:
             if work is None:
                 return
             padded, n, b, futs, sizes = work
-            try:
-                out = self._forward_padded(padded, n, b)
-                off = 0
-                for fut, k in zip(futs, sizes):
-                    if not fut.done():  # shutdown sweep may have failed it
-                        fut.set_result(self._rows(out, off, off + k))
-                    off += k
-            except BaseException as e:  # propagate to all waiting callers
-                for fut in futs:
-                    if not fut.done():
-                        fut.set_exception(e)
+            # busy only while a group is in hand: a forward that never
+            # returns (device wedge) leaves this slot stale and the
+            # watchdog flips serving_dispatcher to degraded/unhealthy
+            with self._hb_dispatch.busy():
+                try:
+                    out = self._forward_padded(padded, n, b)
+                    off = 0
+                    for fut, k in zip(futs, sizes):
+                        if not fut.done():  # shutdown sweep may have failed
+                            fut.set_result(self._rows(out, off, off + k))
+                        off += k
+                except BaseException as e:  # propagate to waiting callers
+                    for fut in futs:
+                        if not fut.done():
+                            fut.set_exception(e)

@@ -44,6 +44,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from deeplearning4j_tpu.utils import health as _health
 from deeplearning4j_tpu.utils import metrics as _metrics
 from deeplearning4j_tpu.utils.concurrency import (
     QueueAborted,
@@ -215,6 +216,11 @@ class EmbeddingPSClient:
             "paramserver_client_push_dropped_total",
             "push batches lost to dead/misbehaving endpoints").labels()
         self._stop = threading.Event()
+        # liveness: the drain holds a busy slot only while delivering a
+        # push batch — a wedged endpoint (socket past its timeout, DNS
+        # hang) flips `component_health{component=paramserver_push}`
+        self._hb = _health.get_health().register(
+            "paramserver_push", stall_after=max(60.0, 4.0 * timeout))
         self._worker = threading.Thread(target=self._drain, daemon=True,
                                         name="dl4j-paramserver-push")
         self._worker.start()
@@ -307,6 +313,7 @@ class EmbeddingPSClient:
         the daemon thread then finishes (or dies) on its own."""
         self._stop.set()
         self._worker.join(timeout=10)
+        _health.get_health().unregister(self._hb)
 
     def _drain(self):
         while True:
@@ -315,13 +322,14 @@ class EmbeddingPSClient:
             except QueueAborted:
                 return
             try:
-                for s, url in enumerate(self.urls):
-                    sel = np.nonzero(rows % len(self.urls) == s)[0]
-                    if sel.size == 0:
-                        continue
-                    self._post_bin(url, "/push.bin",
-                                   _pack_request(table, rows[sel],
-                                                 deltas[sel]))
+                with self._hb.busy():
+                    for s, url in enumerate(self.urls):
+                        sel = np.nonzero(rows % len(self.urls) == s)[0]
+                        if sel.size == 0:
+                            continue
+                        self._post_bin(url, "/push.bin",
+                                       _pack_request(table, rows[sel],
+                                                     deltas[sel]))
             except Exception as e:
                 # endpoint down or reply malformed: drop THIS push and keep
                 # the drain thread alive — a dead thread would silently

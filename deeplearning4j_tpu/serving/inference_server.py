@@ -35,6 +35,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import logging
 import time
 import urllib.parse
 from typing import Optional, Sequence
@@ -46,10 +47,13 @@ from deeplearning4j_tpu.parallel.inference import (
     ParallelInference,
     RequestValidationError,
 )
+from deeplearning4j_tpu.utils import health as _health
 from deeplearning4j_tpu.utils import metrics as _metrics
 from deeplearning4j_tpu.utils import tracing as _tracing
 from deeplearning4j_tpu.utils.jsonhttp import JsonHttpServer, json_response
 from deeplearning4j_tpu.utils.latency import LatencyTracker
+
+logger = logging.getLogger("deeplearning4j_tpu")
 
 
 class InferenceServer:
@@ -63,10 +67,11 @@ class InferenceServer:
         batch_timeout_ms: float = 2.0,
         buckets: Optional[Sequence[int]] = None,
         warmup_shape: Optional[Sequence[int]] = None,
+        health_stall_after: float = 30.0,
     ):
         self.inference = ParallelInference(
             model, mesh, inference_mode, max_batch_size, batch_timeout_ms,
-            buckets,
+            buckets, health_stall_after=health_stall_after,
         )
         if warmup_shape is not None:
             self.inference.warmup(warmup_shape)
@@ -98,16 +103,28 @@ class InferenceServer:
         query = urllib.parse.parse_qs(parsed.query)
         fmt = (query.get("format") or [""])[0]
         if route == "/health":
+            # the aggregated health model (utils/health): worst component
+            # status, with per-component stall detail. 503 when UNHEALTHY
+            # so load balancers stop routing here (the replica-eviction
+            # hook); degraded stays 200 — shedding, not eviction.
             shape = self.inference._expected_shape
+            h = _health.get_health().status()
+            code = 503 if h["status"] == _health.UNHEALTHY else 200
             return json_response({
-                "status": "ok",
+                "status": h["status"],
+                "components": h["components"],
                 "model": type(self.inference.model).__name__,
                 "feature_shape": None if shape is None else list(shape),
-            })
+            }, code)
         if route == "/metrics":
             if fmt == "prometheus":
                 text = _metrics.get_registry().to_prometheus()
                 return 200, "text/plain; version=0.0.4", text.encode()
+            if fmt == "registry":
+                # the registry's JSON snapshot (same series as the
+                # prometheus exposition, machine-readable) — what
+                # `cli metrics --watch --url` diffs per tick
+                return json_response(_metrics.get_registry().snapshot())
             return json_response(self.metrics())
         if route == "/trace":
             # recent host spans — JSONL by default (tail-able), or the
@@ -206,9 +223,15 @@ def main(argv=None):
         batch_timeout_ms=args.batchTimeoutMs, buckets=buckets,
         warmup_shape=warmup,
     )
+    # operator surface: opt in to real log output, then announce through
+    # the package logger (library code never prints — lint CC006)
+    from deeplearning4j_tpu import configure_logging
+
+    if all(isinstance(h, logging.NullHandler) for h in logger.handlers):
+        configure_logging()
     port = server.start()
-    print(f"inference server listening on :{port} "
-          f"(buckets {server.inference.buckets})")
+    logger.info("inference server listening on :%d (buckets %s)",
+                port, server.inference.buckets)
     try:
         server.join()
     except KeyboardInterrupt:

@@ -16,11 +16,14 @@ from __future__ import annotations
 
 import argparse
 import json
+import logging
 
 import numpy as np
 
 from deeplearning4j_tpu.clustering.vptree import VPTree
 from deeplearning4j_tpu.utils.jsonhttp import JsonHttpServer, json_response
+
+logger = logging.getLogger("deeplearning4j_tpu")
 
 
 class NearestNeighborsServer:
@@ -95,8 +98,14 @@ def main(argv=None):
     points = np.load(args.ndarrayPath)
     server = NearestNeighborsServer(points, args.similarityFunction,
                                     args.invert, args.nearestNeighborsPort)
+    # operator surface: announce through the package logger (library
+    # code never prints — lint CC006); opt in to real output first
+    from deeplearning4j_tpu import configure_logging
+
+    if all(isinstance(h, logging.NullHandler) for h in logger.handlers):
+        configure_logging()
     port = server.start()
-    print(f"nearest-neighbors server listening on :{port}")
+    logger.info("nearest-neighbors server listening on :%d", port)
     try:
         server.join()
     except KeyboardInterrupt:

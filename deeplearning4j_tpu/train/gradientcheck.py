@@ -17,11 +17,14 @@ meant; any non-differentiable kink or masking bug shows up as a mismatch).
 
 from __future__ import annotations
 
+import logging
 from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+logger = logging.getLogger("deeplearning4j_tpu")
 
 
 def enable_x64():
@@ -98,9 +101,11 @@ def check_gradients_fn(
             if rel > max_rel_error and abs(a - m) > min_abs_error:
                 fails += 1
                 if verbose:
-                    print(f"param {i}: analytic={a:.8g} numeric={m:.8g} rel={rel:.3g}")
+                    logger.info("param %d: analytic=%.8g numeric=%.8g "
+                                "rel=%.3g", i, a, m, rel)
         if verbose:
-            print(f"gradient check: {len(list(indices)) - fails}/{len(list(indices))} ok")
+            logger.info("gradient check: %d/%d ok",
+                        len(list(indices)) - fails, len(list(indices)))
         return fails == 0
 
 

@@ -180,6 +180,64 @@ class TracingListener(IterationListener):
             self._tracing.enable(bool(self._was_enabled))
 
 
+class HealthTransitionListener(IterationListener):
+    """Forward watchdog health transitions (utils/health — component
+    degraded/recovered events) into the stats-storage path, so the UI
+    layer sees degradation HISTORY, not just the current
+    `component_health` gauge value.
+
+    Cursor-based: each `iteration_done` drains transitions newer than
+    the last seen sequence number and routes them as one update record
+    (`{"health_transitions": [...]}`) through the same
+    StatsStorageRouter StatsListener uses; `on_fit_end` drains once more
+    so a transition during the final partial window still lands. With no
+    router it degrades to the package logger — degradations are never
+    silent."""
+
+    def __init__(self, router=None, session_id: Optional[str] = None):
+        import uuid
+
+        from deeplearning4j_tpu.utils.health import get_health
+
+        self._health = get_health()
+        self.router = router
+        self.session_id = session_id or f"session-{uuid.uuid4().hex[:8]}"
+        # start the cursor NOW: transitions from before this run belong
+        # to whatever run recorded them
+        self._seq = self._health.last_seq()
+
+    def _drain(self, iteration: int):
+        new = self._health.transitions_since(self._seq)
+        if not new:
+            return
+        self._seq = max(t["seq"] for t in new)
+        if self.router is not None:
+            from deeplearning4j_tpu.utils.health import LEVELS
+
+            # health_level carries the numeric end-state per component:
+            # the binary stats codec (ui/codec) drops string leaves, so
+            # the component-keyed numeric map is what survives
+            # FileStatsStorage/remote routing; the raw transition dicts
+            # ride along for in-memory/dashboard consumers
+            self.router.put_update(self.session_id, {
+                "iteration": int(iteration),
+                "ts": time.time(),
+                "health_transitions": new,
+                "health_level": {t["component"]: LEVELS[t["to"]]
+                                 for t in new},
+            })
+        for t in new:
+            logger.info("health: %s %s -> %s (stalled %.3fs)",
+                        t["component"], t["from"], t["to"],
+                        t["stalled_for_seconds"])
+
+    def iteration_done(self, model, iteration, info):
+        self._drain(iteration)
+
+    def on_fit_end(self, model):
+        self._drain(getattr(model, "iteration", 0))
+
+
 class ComposableIterationListener(IterationListener):
     def __init__(self, *listeners):
         self.listeners = list(listeners)

@@ -121,6 +121,13 @@ class StatsListener(IterationListener):
         self._sent_static = False
         self._last_time: Optional[float] = None
         self._samples_since = 0
+        # watchdog transition cursor (utils/health): degradation history
+        # rides the session's main record stream, so the dashboard can
+        # show WHEN a component stalled, not just its current gauge
+        from deeplearning4j_tpu.utils.health import get_health
+
+        self._health = get_health()
+        self._health_seq = self._health.last_seq()
 
     # -- static info (once per session) --------------------------------------
 
@@ -181,6 +188,14 @@ class StatsListener(IterationListener):
             mem = _device_memory_stats()
             if mem:
                 rec["memory"] = mem
+        new_tr = self._health.transitions_since(self._health_seq)
+        if new_tr:
+            from deeplearning4j_tpu.utils.health import LEVELS
+
+            self._health_seq = max(t["seq"] for t in new_tr)
+            rec["health_transitions"] = new_tr
+            rec["health_level"] = {t["component"]: LEVELS[t["to"]]
+                                   for t in new_tr}
         self._reports += 1
         if (self.histogram_bins > 0
                 and (self._reports - 1) % self.histogram_frequency == 0):

@@ -24,6 +24,7 @@ import urllib.request
 from typing import Callable, Dict, List, Optional
 
 from deeplearning4j_tpu.ui.codec import decode_record, encode_record
+from deeplearning4j_tpu.utils import health as _health
 from deeplearning4j_tpu.utils.concurrency import QueueAborted, get_abortable
 
 
@@ -291,6 +292,11 @@ class RemoteUIStatsStorageRouter(StatsStorageRouter):
         self.timeout = timeout
         self._q: "queue.Queue[tuple]" = queue.Queue(maxsize=queue_size)
         self._stop = threading.Event()
+        # liveness: busy only while posting one record — a wedged
+        # dashboard connection past its timeout shows up as a
+        # `component_health{component=ui_remote_router}` stall
+        self._hb = _health.get_health().register(
+            "ui_remote_router", stall_after=max(60.0, 4.0 * timeout))
         self._worker = threading.Thread(target=self._drain, daemon=True,
                                         name="dl4j-ui-remote-router")
         self._worker.start()
@@ -302,6 +308,7 @@ class RemoteUIStatsStorageRouter(StatsStorageRouter):
         call flush() first when delivery must be confirmed."""
         self._stop.set()
         self._worker.join(timeout=10)
+        _health.get_health().unregister(self._hb)
 
     def _drain(self):
         while True:
@@ -315,7 +322,8 @@ class RemoteUIStatsStorageRouter(StatsStorageRouter):
                 headers={"Content-Type": ctype,
                          "X-Session-Id": session_id})
             try:
-                urllib.request.urlopen(req, timeout=self.timeout).read()
+                with self._hb.busy():
+                    urllib.request.urlopen(req, timeout=self.timeout).read()
             except OSError:
                 pass  # dashboard unreachable — drop the record
             finally:

@@ -369,6 +369,32 @@ class MetricsRegistry:
                              "values": values}
         return out
 
+    def scalar_values(self) -> Dict[str, float]:
+        """Flat {series: value} view of every family — counters/gauges by
+        value, histograms by `:count`/`:sum` — with labels rendered into
+        the key. Deliberately cheap (no percentile sorting, no bucket
+        walk): the flight recorder captures deltas of this on the fit
+        hot path, and `cli metrics --watch` diffs it per tick."""
+        with self._lock:
+            fams = list(self._families.values())
+        out: Dict[str, float] = {}
+        for fam in fams:
+            for key, child in fam.children():
+                lab = ""
+                if key:
+                    pairs = ",".join(
+                        f'{n}="{escape_label_value(v)}"'
+                        for n, v in zip(fam.labelnames, key))
+                    lab = "{" + pairs + "}"
+                if fam.kind == "histogram":
+                    out[f"{fam.name}{lab}:count"] = float(child.count)
+                    out[f"{fam.name}{lab}:sum"] = float(child.sum)
+                else:
+                    v = float(child.value)
+                    if math.isfinite(v):
+                        out[f"{fam.name}{lab}"] = v
+        return out
+
     def to_prometheus(self) -> str:
         """Text exposition (format 0.0.4). Counters are suffixed `_total`
         when the registered name doesn't already end that way; histograms

@@ -28,8 +28,13 @@ bash scripts/lint.sh || exit 1
 # T1_METRICS_DUMP=1 makes tests/conftest.py write the shared metrics
 # registry's snapshot after the session (T1_METRICS_ARTIFACT, default
 # /tmp/_t1_metrics.json) — diff compile counts across PRs.
+# T1_BLACKBOX_ARTIFACT arms the flight recorder's crash hooks
+# (tests/conftest.py -> utils/blackbox.install_crash_hooks): a session
+# the timeout kills leaves a dump naming the wedged thread — render it
+# with `python -m deeplearning4j_tpu.cli blackbox <artifact>`.
+blackbox="${T1_BLACKBOX_ARTIFACT:-/tmp/_t1_blackbox.json}"
 rm -f /tmp/_t1.log
-timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu T1_BLACKBOX_ARTIFACT="$blackbox" python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
 rc=${PIPESTATUS[0]}
 echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
 
@@ -45,6 +50,12 @@ grep -aE '^(FAILED|ERROR) ' /tmp/_t1.log \
 
 if [ "$rc" -gt 1 ]; then
     echo "T1: pytest exited rc=$rc (timeout/internal error) — not gating on names"
+    if [ -f "$blackbox" ]; then
+        echo "T1 BLACKBOX: $blackbox (render: python -m deeplearning4j_tpu.cli blackbox $blackbox)"
+        [ -f "$blackbox.stacks.txt" ] && echo "T1 BLACKBOX: native-level thread stacks: $blackbox.stacks.txt"
+    else
+        echo "T1 BLACKBOX: no artifact at $blackbox (session died before the hooks armed?)"
+    fi
     exit "$rc"
 fi
 new_failures=$(comm -13 <(sort -u "$baseline") "$artifact")

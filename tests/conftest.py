@@ -30,6 +30,17 @@ jax.config.update("jax_default_matmul_precision", "highest")
 
 import pytest  # noqa: E402
 
+# Crash forensics for the tier-1 session (scripts/t1.sh sets
+# T1_BLACKBOX_ARTIFACT): arm the flight recorder's SIGTERM/faulthandler/
+# atexit hooks so a wedged session killed by the suite timeout leaves a
+# dump naming the stuck thread (render with `cli blackbox <artifact>`)
+# instead of just "pytest died".
+_bb_artifact = os.environ.get("T1_BLACKBOX_ARTIFACT")
+if _bb_artifact:
+    from deeplearning4j_tpu.utils.blackbox import install_crash_hooks
+
+    install_crash_hooks(_bb_artifact)
+
 
 def pytest_configure(config):
     config.addinivalue_line(

@@ -1,0 +1,680 @@
+"""Flight recorder + hang watchdog + component health (utils/blackbox,
+utils/health) — the crash-forensics layer.
+
+Acceptance coverage (ISSUE 6): a subprocess killed via SIGTERM mid-fit
+leaves a dump that `cli blackbox` renders with the last recorded step
+index and the dl4j-* thread stacks; an injected stall (blocked serving
+dispatcher, stalled prefetch worker) flips `component_health` to
+degraded within the watchdog interval over `GET /health` and recovers
+when unblocked; the flight recorder's hot-path cost stays within noise
+of the tracing-off fit baseline.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.data.iterators import DataSetIterator
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.utils import metrics as metrics_mod
+from deeplearning4j_tpu.utils.blackbox import (
+    FlightRecorder,
+    get_recorder,
+    render_dump,
+)
+from deeplearning4j_tpu.utils.health import (
+    DEGRADED,
+    OK,
+    UNHEALTHY,
+    StepHangError,
+    get_health,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _mlp_conf(n_in=6, n_out=3):
+    return (NeuralNetConfiguration.builder().seed(7).list()
+            .layer(DenseLayer(n_in=n_in, n_out=8, activation="relu"))
+            .layer(OutputLayer(n_in=8, n_out=n_out, activation="softmax",
+                               loss="mcxent"))
+            .build())
+
+
+def _xy(n=40, n_in=6, n_out=3, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.random((n, n_in), np.float32)
+    y = np.eye(n_out, dtype=np.float32)[rng.integers(0, n_out, n)]
+    return x, y
+
+
+def _wait_until(pred, timeout=8.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        v = pred()
+        if v:
+            return v
+        time.sleep(interval)
+    return pred()
+
+
+# -- flight recorder ----------------------------------------------------------
+
+def test_recorder_ring_is_bounded_and_keeps_newest():
+    rec = FlightRecorder(capacity=16, metrics_every=10_000)
+    for i in range(300):
+        rec.record_step(i, score=float(i), data_wait=0.001, dispatch=0.002)
+    snap = rec.snapshot("test")
+    assert snap["steps_recorded_total"] == 300
+    assert len(snap["steps"]) == 16
+    assert snap["last_step"] == 299
+    assert [r["step"] for r in snap["steps"]] == list(range(284, 300))
+    # scores resolve to floats; phase timings survive
+    assert snap["steps"][-1]["score"] == 299.0
+    assert snap["steps"][-1]["dispatch"] == pytest.approx(0.002)
+
+
+def test_recorder_events_and_metrics_deltas():
+    rec = FlightRecorder(capacity=8, metrics_every=10_000)
+    rec.record_event("compile", compile_kind="output", key="(1, 2)")
+    c = metrics_mod.get_registry().counter(
+        "bb_test_delta_total", "test").labels()
+    rec.record_metrics_delta()  # establishes the baseline sample
+    c.inc(5)
+    rec.record_metrics_delta()
+    snap = rec.snapshot("test")
+    assert snap["events"][-1]["kind"] == "compile"
+    deltas = snap["metrics_deltas"]
+    assert deltas, "second capture should have produced a delta"
+    assert deltas[-1]["delta"]["bb_test_delta_total"] == 5
+
+
+def test_recorder_pending_score_is_not_synced():
+    class NeverReady:
+        def is_ready(self):
+            return False
+
+        def __float__(self):  # a sync would be a contract violation
+            raise AssertionError("snapshot must not block on the device")
+
+    rec = FlightRecorder(capacity=4, metrics_every=10_000)
+    rec.record_step(0, score=NeverReady())
+    snap = rec.snapshot("test")
+    assert snap["steps"][0]["score"] == "pending"
+
+
+def test_dump_write_and_render(tmp_path):
+    rec = FlightRecorder(capacity=8, metrics_every=10_000)
+    rec.record_step(41, score=0.5, data_wait=0.01, dispatch=0.02)
+    rec.record_step(42, score=0.25, data_wait=0.01, dispatch=0.02)
+    path = rec.dump(str(tmp_path / "bb.json"), reason="unit test")
+    assert path and os.path.exists(path)
+    with open(path) as f:
+        doc = json.load(f)
+    assert doc["reason"] == "unit test"
+    assert doc["last_step"] == 42
+    text = render_dump(doc)
+    assert "blackbox dump" in text
+    assert "42" in text and "unit test" in text
+    # the dumping thread itself is always in the stacks section
+    assert "MainThread" in text
+
+
+# -- watchdog + component health ---------------------------------------------
+
+def test_watchdog_stall_detection_recovery_and_series():
+    reg = metrics_mod.get_registry()
+    h = get_health()
+    stalls0 = reg.counter(
+        "watchdog_stall_total", "", ("component",)).labels("bb_demo").value
+    seq0 = h.last_seq()
+    seen = []
+    listener = seen.append
+    h.add_listener(listener)
+    hb = h.register("bb_demo", stall_after=0.1)
+    ev = threading.Event()
+
+    def work():
+        with hb.busy():
+            ev.wait(10)
+
+    t = threading.Thread(target=work, daemon=True, name="dl4j-bb-demo")
+    t.start()
+    try:
+        assert _wait_until(
+            lambda: h.status()["components"]["bb_demo"]["status"] != OK)
+        detail = h.status()["components"]["bb_demo"]
+        assert detail["stalled_for_seconds"] > 0
+        assert "dl4j-bb-demo" in detail["stalled_threads"]
+        # the gauge follows the scan, the stall counter opened an episode
+        assert _wait_until(lambda: reg.gauge(
+            "component_health", "", ("component",))
+            .labels("bb_demo").value >= 1)
+        assert _wait_until(lambda: reg.counter(
+            "watchdog_stall_total", "", ("component",))
+            .labels("bb_demo").value == stalls0 + 1)
+        # first degradation handed the flight recorder a snapshot (the
+        # watchdog thread writes it just after the counter — poll)
+        assert _wait_until(
+            lambda: get_recorder().last_degradation is not None)
+        assert any(e["kind"] == "degraded"
+                   for e in get_recorder().snapshot()["events"])
+    finally:
+        ev.set()
+        t.join(5)
+    assert _wait_until(
+        lambda: h.status()["components"]["bb_demo"]["status"] == OK)
+
+    def pairs():
+        return [(tr["from"], tr["to"]) for tr in h.transitions_since(seq0)
+                if tr["component"] == "bb_demo"]
+
+    # transitions are appended by the SCAN (status above is live) — poll
+    assert _wait_until(lambda: any(to == OK for _, to in pairs()[1:]))
+    assert (OK, DEGRADED) in pairs()
+    assert _wait_until(lambda: any(
+        tr["component"] == "bb_demo" for tr in seen))
+    h.remove_listener(listener)
+    h.unregister(hb)
+    assert "bb_demo" not in h.status()["components"]
+
+
+def test_shared_heartbeat_oldest_busy_slot_wins():
+    """A multi-worker component (the ETL stage) stalls when ANY worker
+    wedges — siblings' progress must not mask it."""
+    h = get_health()
+    hb = h.register("bb_shared", stall_after=0.15)
+    stop = threading.Event()
+    wedge = threading.Event()
+
+    def healthy_worker():
+        while not stop.is_set():
+            with hb.busy():
+                hb.beat()
+                time.sleep(0.01)
+
+    def wedged_worker():
+        with hb.busy():
+            wedge.wait(10)
+
+    t1 = threading.Thread(target=healthy_worker, daemon=True,
+                          name="dl4j-bb-healthy")
+    t2 = threading.Thread(target=wedged_worker, daemon=True,
+                          name="dl4j-bb-wedged")
+    t1.start()
+    t2.start()
+    try:
+        assert _wait_until(
+            lambda: h.status()["components"]["bb_shared"]["status"] != OK)
+        assert "dl4j-bb-wedged" in \
+            h.status()["components"]["bb_shared"]["stalled_threads"]
+    finally:
+        stop.set()
+        wedge.set()
+        t1.join(5)
+        t2.join(5)
+        h.unregister(hb)
+
+
+def test_idle_component_is_healthy():
+    """No busy slot = idle = ok, regardless of how long ago the last
+    work happened (waiting for traffic is not a stall)."""
+    h = get_health()
+    hb = h.register("bb_idle", stall_after=0.05)
+    try:
+        time.sleep(0.2)
+        h.scan()
+        assert h.status()["components"]["bb_idle"]["status"] == OK
+    finally:
+        h.unregister(hb)
+
+
+# -- fit wiring ---------------------------------------------------------------
+
+def test_fit_records_steps_and_unregisters_heartbeat():
+    rec = get_recorder()
+    before = rec.snapshot()["steps_recorded_total"]
+    net = MultiLayerNetwork(_mlp_conf()).init()
+    x, y = _xy(n=40)
+    net.fit(x, y, epochs=1, batch_size=10, async_prefetch=False)
+    snap = rec.snapshot()
+    assert snap["steps_recorded_total"] == before + 4
+    last = snap["steps"][-1]
+    assert {"ts", "step", "score", "data_wait", "dispatch"} <= set(last)
+    # heartbeat lifecycle: registered for the fit, gone afterwards
+    assert "fit" not in get_health().status()["components"]
+
+
+def test_fit_hang_timeout_raises_diagnosable_error():
+    net = MultiLayerNetwork(_mlp_conf()).init()
+    x, y = _xy(n=20)
+
+    class StallingIterator(DataSetIterator):
+        def __iter__(self):
+            yield DataSet(x[:10], y[:10])
+            for _ in range(1000):  # a python-level wedge, 20s worth
+                time.sleep(0.02)
+
+        def reset(self):
+            pass
+
+        def batch_size(self):
+            return 10
+
+        def total_examples(self):
+            return 20
+
+    t0 = time.monotonic()
+    with pytest.raises(StepHangError) as ei:
+        net.fit(StallingIterator(), epochs=1, async_prefetch=False,
+                hang_timeout=0.3)
+    assert time.monotonic() - t0 < 15, "hang was not cut short"
+    e = ei.value
+    assert e.dump_path and os.path.exists(e.dump_path)
+    with open(e.dump_path) as f:
+        doc = json.load(f)
+    assert "hang" in doc["reason"]
+    assert doc["last_step"] is not None
+    # fit component cleaned up even on the hang path
+    assert "fit" not in get_health().status()["components"]
+
+
+def test_recorder_hot_path_overhead_within_noise():
+    """Flight-recorder-on step time vs recorder-off (the PR 3 tracing-off
+    baseline): the per-step cost is a ring append, so the A/B must be
+    within noise. Asserted twice: a stable microbench bound on
+    record_step itself, and a generous wall-clock ratio on real fits."""
+    rec = get_recorder()
+    t0 = time.perf_counter()
+    for i in range(10_000):
+        rec.record_step(i, score=None, data_wait=0.0, dispatch=0.001)
+    per_call = (time.perf_counter() - t0) / 10_000
+    assert per_call < 100e-6, f"record_step cost {per_call * 1e6:.1f}us"
+
+    x, y = _xy(n=200)
+
+    def fit_once():
+        net = MultiLayerNetwork(_mlp_conf()).init()
+        net.fit(x, y, epochs=1, batch_size=4, async_prefetch=False)  # 50
+        t = time.perf_counter()
+        net.fit(x, y, epochs=1, batch_size=4, async_prefetch=False)
+        return time.perf_counter() - t
+
+    # interleave on/off runs so machine-load drift hits both sides, and
+    # compare minima (the noise-free floor); the recorder's true cost is
+    # ~µs on a ~ms step, so generous headroom still catches a real
+    # hot-path regression (e.g. a per-step registry walk)
+    on_t, off_t = [], []
+    try:
+        for _ in range(3):
+            rec.enabled = True
+            on_t.append(fit_once())
+            rec.enabled = False
+            off_t.append(fit_once())
+    finally:
+        rec.enabled = True
+    assert min(on_t) < min(off_t) * 1.8 + 0.1, (on_t, off_t)
+
+
+# -- injected stalls: pipeline + serving --------------------------------------
+
+def test_prefetch_worker_stall_flips_component_and_recovers():
+    from deeplearning4j_tpu.data.iterators import ListDataSetIterator
+    from deeplearning4j_tpu.data.prefetch import DevicePrefetchIterator
+
+    x, y = _xy(n=30)
+    h = get_health()
+    unwedge = threading.Event()
+    first = [True]
+
+    def wedging_transform(ds):
+        if first[0]:
+            first[0] = False
+            unwedge.wait(15)
+        return ds
+
+    it = DevicePrefetchIterator(
+        ListDataSetIterator(DataSet(x, y), 10), depth=1,
+        transform=wedging_transform, health_stall_after=0.12)
+    got = []
+
+    def consume():
+        for ds in it:
+            got.append(ds)
+
+    t = threading.Thread(target=consume, daemon=True,
+                         name="dl4j-bb-consumer")
+    t.start()
+    try:
+        comp = lambda: h.status()["components"].get("device_prefetch")
+        assert _wait_until(lambda: (comp() or {}).get("status") == DEGRADED)
+        # the gauge follows the next scan — poll it
+        assert _wait_until(lambda: metrics_mod.get_registry().gauge(
+            "component_health", "", ("component",))
+            .labels("device_prefetch").value >= 1)
+    finally:
+        unwedge.set()
+        t.join(10)
+    assert len(got) == 3
+    # run complete -> heartbeat unregistered -> gauge back to ok
+    assert "device_prefetch" not in h.status()["components"]
+    assert metrics_mod.get_registry().gauge(
+        "component_health", "", ("component",)) \
+        .labels("device_prefetch").value == 0
+    it.close()
+
+
+def test_serving_dispatcher_stall_over_health_route():
+    """The acceptance flow: a blocked dispatcher flips GET /health to
+    degraded within the watchdog interval, 503s once unhealthy, and
+    recovers to ok when unblocked."""
+    from deeplearning4j_tpu.serving.inference_server import InferenceServer
+
+    net = MultiLayerNetwork(_mlp_conf(n_in=4, n_out=2)).init()
+    srv = InferenceServer(net, max_batch_size=8, health_stall_after=0.2)
+    port = srv.start()
+
+    def get_health_route():
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/health", timeout=5) as r:
+                return r.status, json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read())
+
+    try:
+        code, body = get_health_route()
+        assert (code, body["status"]) == (200, OK)
+
+        # the registry-JSON scrape cli metrics --watch --url diffs
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics?format=registry",
+                timeout=5) as r:
+            snap = json.loads(r.read())
+        assert snap["component_health"]["type"] == "gauge"
+
+        blocked = threading.Event()
+        orig = net.output
+
+        def wedged_output(xx, *a, **k):
+            blocked.wait(20)
+            return orig(xx, *a, **k)
+
+        net.output = wedged_output
+        res = []
+        client = threading.Thread(
+            target=lambda: res.append(np.asarray(srv.inference.output(
+                np.random.default_rng(0).random((2, 4), np.float32)))),
+            daemon=True, name="dl4j-bb-client")
+        client.start()
+        # degraded within the watchdog interval...
+        assert _wait_until(lambda: get_health_route()[1]["status"] != OK)
+        code, body = get_health_route()
+        comp = body["components"]["serving_dispatcher"]
+        assert comp["status"] in (DEGRADED, UNHEALTHY)
+        assert "dl4j-serving-dispatch" in comp["stalled_threads"]
+        # ...503 once unhealthy (stall_after * 4)...
+        assert _wait_until(lambda: get_health_route()[0] == 503, timeout=10)
+        assert get_health_route()[1]["status"] == UNHEALTHY
+        # ...and full recovery when unblocked
+        net.output = orig
+        blocked.set()
+        client.join(10)
+        assert res and res[0].shape == (2, 2)
+        assert _wait_until(
+            lambda: get_health_route()[1]["status"] == OK)
+        assert get_health_route()[0] == 200
+    finally:
+        srv.stop()
+    # shutdown unregisters the serving components
+    comps = get_health().status()["components"]
+    assert "serving_dispatcher" not in comps
+    assert "serving_collector" not in comps
+
+
+# -- SIGTERM forensics round-trip ---------------------------------------------
+
+_CHILD_SCRIPT = """
+import os, sys, time
+sys.path.insert(0, {repo!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from deeplearning4j_tpu.utils.blackbox import install_crash_hooks
+from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.data.iterators import DataSetIterator
+from deeplearning4j_tpu.data.dataset import DataSet
+
+dump_path, marker = sys.argv[1], sys.argv[2]
+install_crash_hooks(dump_path, dump_on_exit=False)
+conf = (NeuralNetConfiguration.builder().seed(1).list()
+        .layer(DenseLayer(n_in=4, n_out=4, activation="relu"))
+        .layer(OutputLayer(n_in=4, n_out=2, activation="softmax",
+                           loss="mcxent"))
+        .build())
+net = MultiLayerNetwork(conf).init()
+rng = np.random.default_rng(0)
+x = rng.random((8, 4), np.float32)
+y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 8)]
+
+class Endless(DataSetIterator):
+    def __iter__(self):
+        while True:
+            yield DataSet(x, y)
+            time.sleep(0.01)
+    def reset(self): pass
+    def batch_size(self): return 8
+    def total_examples(self): return None
+
+from deeplearning4j_tpu.train.listeners import IterationListener
+
+class Marker(IterationListener):
+    # marker keyed on FIT iterations (the prefetch pipeline's iterator
+    # position runs ahead of the dispatched steps), so the parent's
+    # SIGTERM arrives with >= 4 steps in the flight recorder
+    def iteration_done(self, model, iteration, info):
+        time.sleep(0.03)
+        if iteration >= 3 and not os.path.exists(marker):
+            with open(marker, "w") as f:
+                f.write("ready")
+
+net.set_listeners(Marker())
+net.fit(Endless(), epochs=1, async_prefetch=True)
+"""
+
+
+def test_sigterm_mid_fit_leaves_renderable_dump(tmp_path, capsys):
+    dump_path = str(tmp_path / "crash.json")
+    marker = str(tmp_path / "ready")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _CHILD_SCRIPT.format(repo=REPO),
+         dump_path, marker],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+    try:
+        assert _wait_until(lambda: os.path.exists(marker), timeout=120,
+                           interval=0.1), "child never reached step 4"
+        proc.send_signal(signal.SIGTERM)
+        proc.wait(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(10)
+    assert os.path.exists(dump_path), proc.stderr.read().decode()
+    with open(dump_path) as f:
+        doc = json.load(f)
+    assert "signal" in doc["reason"]
+    # the fit was mid-flight: at least the marker's 4 steps recorded
+    assert doc["last_step"] is not None and doc["last_step"] >= 3
+    names = [t["name"] for t in doc["threads"]]
+    # the framework's own workers are in the dump with their stacks:
+    # the async-prefetch pipeline threads and the watchdog
+    assert any(n.startswith("dl4j-pipeline") for n in names), names
+    assert "dl4j-watchdog" in names
+    dl4j_stacks = [t for t in doc["threads"]
+                   if t["name"].startswith("dl4j-") and t["alive"]]
+    assert all(t["stack"] for t in dl4j_stacks)
+
+    # and `cli blackbox` renders it
+    from deeplearning4j_tpu.cli import main as cli_main
+
+    assert cli_main(["blackbox", dump_path]) == 0
+    out = capsys.readouterr().out
+    assert "blackbox dump" in out
+    assert f"last step index: {doc['last_step']}" in out
+    assert "dl4j-watchdog" in out
+
+
+# -- listener + UI storage path ----------------------------------------------
+
+def test_health_transition_listener_routes_records():
+    from deeplearning4j_tpu.train.listeners import HealthTransitionListener
+    from deeplearning4j_tpu.ui.storage import InMemoryStatsStorage
+
+    storage = InMemoryStatsStorage()
+    lst = HealthTransitionListener(storage, session_id="bb-session")
+    h = get_health()
+    hb = h.register("bb_listener", stall_after=0.08)
+    ev = threading.Event()
+
+    def work():
+        with hb.busy():
+            ev.wait(10)
+
+    t = threading.Thread(target=work, daemon=True, name="dl4j-bb-lst")
+    t.start()
+    try:
+        assert _wait_until(lambda: h.transitions_since(lst._seq))
+    finally:
+        ev.set()
+        t.join(5)
+    lst.iteration_done(None, 7, {})
+    ups = storage.get_updates("bb-session")
+    assert ups, "transition record never routed"
+    rec = ups[-1]
+    assert rec["iteration"] == 7
+    comps = [tr["component"] for tr in rec["health_transitions"]]
+    assert "bb_listener" in comps
+    assert rec["health_level"]["bb_listener"] >= 1
+    # cursor advanced: a second drain with no news routes nothing
+    n = len(storage.get_updates("bb-session"))
+    _wait_until(lambda: h.status()["components"]["bb_listener"]["status"]
+                == OK)
+    lst.on_fit_end(None)  # may flush the recovery transition
+    h.unregister(hb)
+    lst.iteration_done(None, 8, {})
+    assert len(storage.get_updates("bb-session")) <= n + 1
+
+
+def test_stats_listener_embeds_health_history():
+    from deeplearning4j_tpu.ui import InMemoryStatsStorage, StatsListener
+
+    storage = InMemoryStatsStorage()
+    net = MultiLayerNetwork(_mlp_conf()).init()
+    lst = StatsListener(storage, session_id="bb-stats")
+    x, y = _xy(n=10)
+    net.set_listeners(lst)
+    # inject a transition mid-run by stalling a scratch component
+    h = get_health()
+    hb = h.register("bb_stats_comp", stall_after=0.05)
+    ev = threading.Event()
+
+    def work():
+        with hb.busy():
+            ev.wait(10)
+
+    t = threading.Thread(target=work, daemon=True, name="dl4j-bb-stats")
+    t.start()
+    try:
+        assert _wait_until(lambda: h.transitions_since(lst._health_seq))
+        net.fit(x, y, epochs=1, batch_size=10, async_prefetch=False)
+    finally:
+        ev.set()
+        t.join(5)
+        h.unregister(hb)
+        net.set_listeners()
+    ups = storage.get_updates("bb-stats")
+    assert ups
+    assert any("health_level" in u
+               and u["health_level"].get("bb_stats_comp", 0) >= 1
+               for u in ups)
+
+
+# -- cli surfaces -------------------------------------------------------------
+
+def test_cli_blackbox_missing_file(capsys):
+    from deeplearning4j_tpu.cli import main as cli_main
+
+    assert cli_main(["blackbox", "/nonexistent/dump.json"]) == 2
+
+
+def test_cli_metrics_watch_prints_deltas(capsys):
+    from deeplearning4j_tpu.cli import main as cli_main
+
+    c = metrics_mod.get_registry().counter(
+        "bb_watch_demo_total", "test counter").labels()
+    g = metrics_mod.get_registry().gauge("bb_watch_gauge", "test").labels()
+    c.inc(1)
+    g.set(0)
+
+    def mutate():
+        time.sleep(0.08)
+        c.inc(3)
+        g.set(7)
+
+    t = threading.Thread(target=mutate, daemon=True, name="dl4j-bb-watch")
+    t.start()
+    rc = cli_main(["metrics", "--watch", "0.25", "--watch-count", "2"])
+    t.join(5)
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "bb_watch_demo_total" in out
+    assert "+3" in out
+    assert "bb_watch_gauge" in out and "7" in out
+    assert "tick" in out
+
+
+def test_register_collision_with_live_heartbeat_gets_suffixed_name():
+    """Two live registrants of one component name (e.g. two concurrent
+    fits with hang_timeout) must BOTH stay under watchdog coverage —
+    the newcomer is suffixed, not silently evicting the first."""
+    h = get_health()
+    hb1 = h.register("bb_collide", stall_after=5.0)
+    ev = threading.Event()
+
+    def work():
+        with hb1.busy():
+            ev.wait(10)
+
+    t = threading.Thread(target=work, daemon=True, name="dl4j-bb-col")
+    t.start()
+    try:
+        assert _wait_until(hb1.has_busy_slots)
+        hb2 = h.register("bb_collide", stall_after=5.0)
+        assert hb2.name == "bb_collide#2"
+        comps = h.status()["components"]
+        assert "bb_collide" in comps and "bb_collide#2" in comps
+        # idle collision = restart: replaced under the same name
+        h.unregister(hb2)
+        hb3 = h.register("bb_collide#2", stall_after=5.0)
+        assert hb3.name == "bb_collide#2"
+        h.unregister(hb3)
+    finally:
+        ev.set()
+        t.join(5)
+        h.unregister(hb1)
+    assert "bb_collide" not in h.status()["components"]

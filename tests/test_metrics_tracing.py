@@ -599,3 +599,92 @@ def test_cli_metrics_local_dump(tmp_path, capsys):
     assert main(["metrics", "--format", "prometheus",
                  "--output", str(out)]) == 0
     assert "cli_demo_total 5" in out.read_text().splitlines()
+
+
+# -- exposition edge cases (blackbox/health PR satellites) --------------------
+
+def test_exposition_gauge_family_with_zero_samples():
+    """A registered family whose labels() was never called must still
+    expose a well-formed TYPE (and HELP) block with no sample lines —
+    and survive the snapshot path. component_health before the first
+    watchdog transition is the live trigger for this shape."""
+    reg = MetricsRegistry()
+    reg.gauge("empty_gauge", "no children yet", ("component",))
+    text = reg.to_prometheus()
+    assert "# TYPE empty_gauge gauge" in text
+    assert "# HELP empty_gauge no children yet" in text
+    assert not [l for l in text.splitlines()
+                if l.startswith("empty_gauge") and not l.startswith("#")]
+    snap = reg.snapshot()
+    assert snap["empty_gauge"]["values"] == []
+    # strict-JSON safe even with zero samples
+    json.dumps(snap, allow_nan=False)
+
+
+def test_exposition_label_escaping_roundtrip():
+    """Label values with backslashes, quotes, and newlines survive the
+    text exposition and parse back to the original strings."""
+    import re
+
+    reg = MetricsRegistry()
+    nasty = 'a\\b"c\nd'
+    reg.counter("esc_total", "", ("component",)).labels(nasty).inc(3)
+    text = reg.to_prometheus()
+    line = [l for l in text.splitlines() if l.startswith("esc_total{")][0]
+    assert "\n" not in line  # the newline was escaped, not emitted
+    m = re.match(r'esc_total\{component="((?:[^"\\]|\\.)*)"\} 3', line)
+    assert m, line
+    unescaped = (m.group(1).replace("\\\\", "\x00").replace('\\"', '"')
+                 .replace("\\n", "\n").replace("\x00", "\\"))
+    assert unescaped == nasty
+    # scalar_values (the --watch / flight-recorder view) uses the same
+    # escaping, so the series key is unambiguous too
+    assert f'esc_total{{component="{metrics_mod.escape_label_value(nasty)}"}}' \
+        in reg.scalar_values()
+
+
+def test_exposition_under_concurrent_registry_mutation():
+    """/metrics must stay well-formed while other threads register new
+    families and children mid-scrape (a live serving process does this
+    constantly: warmup compiles, first paramserver push, watchdog
+    transitions)."""
+    reg = MetricsRegistry()
+    stop = threading.Event()
+    errs = []
+
+    def mutate(k):
+        i = 0
+        try:
+            while not stop.is_set():
+                fam = reg.counter(f"mut_{k}_{i % 17}_total", "x", ("l",))
+                fam.labels(f"v{i % 5}").inc()
+                reg.gauge(f"mutg_{k}_{i % 13}", "x").set(i)
+                reg.histogram(f"muth_{k}_{i % 7}_seconds", "x").observe(
+                    0.001 * (i % 50))
+                i += 1
+        except Exception as e:  # pragma: no cover - failure reporting
+            errs.append(e)
+
+    threads = [threading.Thread(target=mutate, args=(k,), daemon=True,
+                                name=f"dl4j-test-mut-{k}")
+               for k in range(3)]
+    for t in threads:
+        t.start()
+    try:
+        for _ in range(30):
+            text = reg.to_prometheus()
+            # every non-comment line is "name{labels} value" with a
+            # parseable numeric value
+            for line in text.splitlines():
+                if not line or line.startswith("#"):
+                    continue
+                name_part, _, value = line.rpartition(" ")
+                assert name_part, line
+                float(value)
+            json.dumps(reg.snapshot(), allow_nan=False)
+            reg.scalar_values()
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(5)
+    assert not errs
