@@ -180,6 +180,8 @@ def cmd_inference_server(args) -> int:
         argv += ["--buckets", args.buckets]
     if args.warmup_shape:
         argv += ["--warmupShape", args.warmup_shape]
+    if args.replicas != 1:
+        argv += ["--replicas", str(args.replicas)]
     inf_main(argv)
     return 0
 
@@ -372,6 +374,59 @@ def cmd_blackbox(args) -> int:
     return 0
 
 
+def cmd_resume(args) -> int:
+    """Operator half of the resume contract (train/checkpoint): describe
+    the newest checkpoint in a directory — iteration/epoch/reason/age and
+    the mid-epoch TrainState it carries — and prove the zip actually
+    loads. Exit 0 when a loadable checkpoint exists, 1 when the directory
+    is empty or every checkpoint is torn/unreadable: scriptable as a
+    pre-flight gate before `fit(resume_from=...)` (or as the init
+    container of a preemptible training pod)."""
+    import json as _json
+
+    from deeplearning4j_tpu.train.checkpoint import describe_latest
+
+    info = describe_latest(args.directory)
+    if info is None:
+        print(f"resume: no checkpoint in {args.directory!r} "
+              "(empty directory = fresh start)", file=sys.stderr)
+        return 1
+    if not args.no_validate:
+        # the describe is metadata-level; this proves the full payload
+        # (config, params, layer/updater state) deserializes
+        from deeplearning4j_tpu.utils.model_serializer import load_model
+
+        try:
+            model = load_model(info["path"])
+        except Exception as e:
+            print(f"resume: newest checkpoint {info['path']} does not "
+                  f"load: {type(e).__name__}: {e}", file=sys.stderr)
+            return 1
+        info["network_type"] = type(model).__name__
+        info["num_params"] = int(model.num_params())
+    if args.json:
+        print(_json.dumps(info, indent=2, default=str))
+        return 0
+    age = info.get("age_seconds")
+    print(f"checkpoint: {info['path']}")
+    print(f"  iteration: {info.get('iteration')}  "
+          f"epoch: {info.get('epoch')}  reason: {info.get('reason')}")
+    if age is not None:
+        print(f"  age: {age:.1f}s")
+    if info.get("network_type"):
+        print(f"  model: {info['network_type']} "
+              f"({info.get('num_params')} params)  validated: loads OK")
+    ts = info.get("train_state")
+    if ts:
+        print(f"  mid-epoch state: epoch {ts.get('epoch')}, "
+              f"{ts.get('batch_in_epoch')} batch(es) into it"
+              + (" (+ iterator state)" if ts.get("iterator_state")
+                 else ""))
+    else:
+        print("  mid-epoch state: none (resume restarts its epoch)")
+    return 0
+
+
 def cmd_doctor(args) -> int:
     """Model doctor: static shape/dtype-flow check of a model's
     configuration plus a jaxpr audit of its train-step loss
@@ -496,6 +551,8 @@ def main(argv=None) -> int:
                    help="comma-separated batch-size buckets")
     i.add_argument("--warmup-shape", default=None,
                    help="feature shape to precompile, e.g. 784 or 28,28,1")
+    i.add_argument("--replicas", type=int, default=1,
+                   help=">=2 serves through a self-healing ReplicaPool")
     i.set_defaults(fn=cmd_inference_server)
 
     u = sub.add_parser("ui-server", help="dashboard over a stats file")
@@ -553,6 +610,19 @@ def main(argv=None) -> int:
     bb.add_argument("--json", action="store_true",
                     help="pretty-print the raw dump instead of rendering")
     bb.set_defaults(fn=cmd_blackbox)
+
+    rs = sub.add_parser(
+        "resume",
+        help="describe + validate the newest checkpoint in a directory "
+             "(exit 1 when empty/torn) — pre-flight for "
+             "fit(resume_from=...)")
+    rs.add_argument("directory", help="checkpoint directory "
+                                      "(train.checkpoint.CheckpointListener)")
+    rs.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+    rs.add_argument("--no-validate", action="store_true",
+                    help="skip the full model load (metadata only)")
+    rs.set_defaults(fn=cmd_resume)
 
     d = sub.add_parser(
         "doctor",

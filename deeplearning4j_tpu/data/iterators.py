@@ -79,7 +79,16 @@ class DataSetIterator:
 
     Iterators that own background workers override `close()` (and get
     `with` support for free); for plain host iterators both are no-ops,
-    so callers can close any DataSetIterator unconditionally."""
+    so callers can close any DataSetIterator unconditionally.
+
+    `state()`/`restore_state()` are the mid-epoch resume protocol
+    (train/checkpoint.py): `state()` returns a small JSON-safe dict of
+    whatever the iterator needs to REPRODUCE an epoch from its start
+    (e.g. the shuffle-epoch counter — not a queue position; in-flight
+    pipeline batches are replayed, not captured), and `restore_state()`
+    primes a fresh iterator with it. The defaults declare the iterator
+    stateless: each epoch is identical, so replay needs no priming.
+    Pipeline wrappers delegate both to their base iterator."""
 
     def __iter__(self) -> Iterator[DataSet]:
         raise NotImplementedError
@@ -92,6 +101,14 @@ class DataSetIterator:
 
     def total_examples(self) -> Optional[int]:
         return None
+
+    def state(self) -> Optional[dict]:
+        """JSON-safe epoch-reproduction state; None = stateless."""
+        return None
+
+    def restore_state(self, state: Optional[dict]) -> None:
+        """Prime a fresh iterator with a `state()` capture. No-op for
+        stateless iterators (and for a None capture)."""
 
     def close(self) -> None:
         """Release background workers/queues, if any. Safe to call more
@@ -142,6 +159,16 @@ class ListDataSetIterator(DataSetIterator):
     def total_examples(self):
         return self.dataset.num_examples()
 
+    def state(self):
+        # the epoch counter seeds the shuffle permutation: restoring it
+        # makes a fresh iterator deal out the SAME epoch order the
+        # checkpointed run saw — the whole point of mid-epoch resume
+        return {"epoch": int(self._epoch)}
+
+    def restore_state(self, state):
+        if state:
+            self._epoch = int(state.get("epoch", 0))
+
 
 class ExistingDataSetIterator(DataSetIterator):
     """Wraps any iterable of DataSets (reference: ExistingDataSetIterator)."""
@@ -172,6 +199,12 @@ class MultipleEpochsIterator(DataSetIterator):
     def batch_size(self):
         return self.base.batch_size()
 
+    def state(self):
+        return self.base.state()
+
+    def restore_state(self, state):
+        self.base.restore_state(state)
+
 
 class MultiDataSetIterator:
     """SPI: iterable over MultiDataSet minibatches with reset()
@@ -189,6 +222,12 @@ class MultiDataSetIterator:
 
     def total_examples(self) -> Optional[int]:
         return None
+
+    def state(self) -> Optional[dict]:
+        return None
+
+    def restore_state(self, state: Optional[dict]) -> None:
+        pass
 
 
 class StackedDataSetIterator(DataSetIterator):
@@ -220,6 +259,12 @@ class StackedDataSetIterator(DataSetIterator):
 
     def total_examples(self):
         return self.base.total_examples()
+
+    def state(self):
+        return self.base.state()
+
+    def restore_state(self, state):
+        self.base.restore_state(state)
 
 
 _SENTINEL = object()
@@ -290,3 +335,9 @@ class AsyncDataSetIterator(DataSetIterator):
 
     def total_examples(self):
         return self.base.total_examples()
+
+    def state(self):
+        return self.base.state()
+
+    def restore_state(self, state):
+        self.base.restore_state(state)

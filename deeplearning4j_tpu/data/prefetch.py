@@ -295,6 +295,18 @@ class ParallelDataSetIterator(DataSetIterator):
         te = getattr(self.base, "total_examples", None)
         return te() if callable(te) else None
 
+    # the resume protocol delegates to the base: ETL workers hold no
+    # replayable position (in-flight batches are re-derived from the
+    # base's epoch state, data/iterators.DataSetIterator.state)
+    def state(self):
+        st = getattr(self.base, "state", None)
+        return st() if callable(st) else None
+
+    def restore_state(self, state):
+        rs = getattr(self.base, "restore_state", None)
+        if callable(rs):
+            rs(state)
+
 
 class DevicePrefetchIterator(DataSetIterator):
     """Device-resident double-buffered prefetch: a background thread
@@ -435,3 +447,12 @@ class DevicePrefetchIterator(DataSetIterator):
 
     def total_examples(self):
         return self.base.total_examples()
+
+    def state(self):
+        st = getattr(self.base, "state", None)
+        return st() if callable(st) else None
+
+    def restore_state(self, state):
+        rs = getattr(self.base, "restore_state", None)
+        if callable(rs):
+            rs(state)

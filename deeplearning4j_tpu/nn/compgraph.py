@@ -419,7 +419,7 @@ class ComputationGraph(NetworkBase):
 
     def fit(self, data, labels=None, *, epochs: int = 1, batch_size: int = 32,
             async_prefetch: bool = True, prefetch_buffer: int = 4,
-            hang_timeout: float = None):
+            hang_timeout: float = None, resume_from: str = None):
         """Train. Accepts (features, labels) arrays, a DataSet/MultiDataSet,
         or a DataSetIterator/MultiDataSetIterator (reference:
         ComputationGraph.fit overloads :857-867). With async_prefetch the
@@ -429,7 +429,10 @@ class ComputationGraph(NetworkBase):
         raises utils.health.StepHangError with a flight-recorder dump
         path instead of blocking forever — pick it above the worst-case
         single phase (first-step trace+compile, longest legitimate data
-        wait)."""
+        wait). `resume_from` names a checkpoint directory: the newest
+        checkpoint loads into this net and the iterator fast-forwards to
+        the saved mid-epoch position (empty directory = fresh start;
+        `epochs` stays the TOTAL target)."""
         self._require_init()
         if isinstance(data, (DataSetIterator, MultiDataSetIterator)):
             iterator = data
@@ -442,7 +445,8 @@ class ComputationGraph(NetworkBase):
                 DataSet(np.asarray(data), np.asarray(labels)), batch_size
             )
         return self._run_fit(iterator, epochs, async_prefetch,
-                             prefetch_buffer, hang_timeout=hang_timeout)
+                             prefetch_buffer, hang_timeout=hang_timeout,
+                             resume_from=resume_from)
 
     def _fit_dataset(self, ds):
         mds = _as_multidataset(ds)

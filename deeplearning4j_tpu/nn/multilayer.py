@@ -607,7 +607,7 @@ class MultiLayerNetwork(NetworkBase):
 
     def fit(self, data, labels=None, *, epochs: int = 1, batch_size: int = 32,
             async_prefetch: bool = True, prefetch_buffer: int = 4,
-            hang_timeout: float = None):
+            hang_timeout: float = None, resume_from: str = None):
         """Train. Accepts (features, labels) arrays, a DataSet, or a
         DataSetIterator (reference: MultiLayerNetwork.fit overloads
         :1019). If the configuration sets pretrain=True, layerwise
@@ -620,14 +620,22 @@ class MultiLayerNetwork(NetworkBase):
         utils.health.StepHangError carrying a flight-recorder dump path
         instead of blocking forever. Pick it above the worst-case single
         phase — the first step's trace+compile and the longest legitimate
-        data wait both count as "no progress" if they exceed it."""
+        data wait both count as "no progress" if they exceed it.
+        `resume_from` names a checkpoint directory (CheckpointListener):
+        the newest checkpoint is loaded into this net, the iterator is
+        fast-forwarded to the saved mid-epoch position, and training
+        continues to the same loss curve as an uninterrupted run; an
+        empty directory starts fresh, so the same command line works on
+        first boot and after a preemption. `epochs` stays the TOTAL
+        target — already-completed epochs are not re-run."""
         self._require_init()
         if self.conf.pretrain and not getattr(self, "_pretrained", False):
             self.pretrain(data, batch_size=batch_size)
             self._pretrained = True
         iterator = self._as_iterator(data, labels, batch_size)
         return self._run_fit(iterator, epochs, async_prefetch,
-                             prefetch_buffer, hang_timeout=hang_timeout)
+                             prefetch_buffer, hang_timeout=hang_timeout,
+                             resume_from=resume_from)
 
     def _as_iterator(self, data, labels, batch_size) -> DataSetIterator:
         if isinstance(data, DataSetIterator):

@@ -84,6 +84,11 @@ class NetworkBase:
         # the watchdog heartbeat of the CURRENT fit (utils/health) — set
         # for the duration of _run_fit; the step path beats it
         self._fit_heartbeat = None
+        # mid-epoch resume bookkeeping (train_state()): epoch, batches
+        # consumed within it, and the data iterator's epoch-start state —
+        # captured by the fit loop, embedded in checkpoints, replayed by
+        # fit(resume_from=...)
+        self._train_state = None
         # where the hang action dumped the flight recorder before raising
         # StepHangError into the fit thread (read when enriching the
         # async-raised bare exception)
@@ -319,7 +324,8 @@ class NetworkBase:
             }
         return ins
 
-    def _timed_fit(self, fit_fn, data_wait: float, n_examples: int):
+    def _timed_fit(self, fit_fn, data_wait: float, n_examples: int,
+                   n_batches: int = 1):
         """Run one dispatch (a single `_fit_dataset` or a fused flush)
         under the step-phase timers: data-wait / dispatch / device-sync,
         each a histogram in the shared registry and a span when tracing
@@ -329,6 +335,12 @@ class NetworkBase:
         ins = self._fit_obs()
         it0 = self.iteration
         sync = None
+        # resume bookkeeping BEFORE the dispatch: a checkpoint listener
+        # firing inside it (post-step _notify) must record this batch as
+        # consumed — the snapshot's params already include its update
+        ts = self._train_state
+        if ts is not None:
+            ts["batch_in_epoch"] += n_batches
         # beat on entry AND exit: each phase (data wait, dispatch) must
         # individually exceed hang_timeout to read as a stall, instead of
         # their sum tripping the watchdog on an input-bound step
@@ -379,7 +391,15 @@ class NetworkBase:
 
     def _run_fit(self, iterator, epochs: int, async_prefetch: bool,
                  prefetch_buffer: int = 4,
-                 hang_timeout: Optional[float] = None):
+                 hang_timeout: Optional[float] = None,
+                 resume_from: Optional[str] = None):
+        skip_batches = 0
+        if resume_from is not None:
+            # restore BEFORE staging: the iterator state lands on the
+            # caller's iterator, not the pipeline wrappers about to be
+            # composed around it
+            skip_batches, epochs = self._restore_for_resume(
+                resume_from, iterator, epochs)
         owned = None
         if async_prefetch:
             staged = self._stage_input_pipeline(iterator, prefetch_buffer)
@@ -404,7 +424,7 @@ class NetworkBase:
         self._fit_heartbeat = hb
         try:
             with hb.busy():
-                self._fit_epochs(iterator, epochs, fuse_k)
+                self._fit_epochs(iterator, epochs, fuse_k, skip_batches)
         except _health.StepHangError as e:
             if e.dump_path is not None:
                 raise  # already carries its forensics
@@ -414,6 +434,10 @@ class NetworkBase:
                 dump_path=self._hang_dump_path) from None
         finally:
             self._fit_heartbeat = None
+            # resume coordinates die with the fit: a preemption save
+            # AFTER a completed fit must record a clean epoch boundary,
+            # not a stale mid-epoch position
+            self._train_state = None
             _health.get_health().unregister(hb)
             # pipeline workers this fit created must die with it, raise
             # or return (the generators' own finally handles the common
@@ -512,10 +536,88 @@ class NetworkBase:
             transform=self._input_transform,
             close_base=wrapped)
 
-    def _fit_epochs(self, iterator, epochs: int, fuse_k: int):
+    def _capture_iterator_state(self, iterator) -> Optional[dict]:
+        """The iterator's epoch-start state (the data/iterators
+        `state()` protocol), JSON-safe, for checkpoints. None when the
+        iterator is stateless or its capture fails — resume then replays
+        positionally only."""
+        state_fn = getattr(iterator, "state", None)
+        if not callable(state_fn):
+            return None
+        try:
+            return state_fn()
+        except Exception:
+            logger.warning("iterator state capture failed; checkpoints "
+                           "will resume positionally only", exc_info=True)
+            return None
+
+    def train_state(self) -> Optional[dict]:
+        """Point-in-time resume coordinates of the CURRENT fit: epoch,
+        batches consumed within it, and the iterator's epoch-start state.
+        Embedded into checkpoints (utils/model_serializer trainState.json)
+        and replayed by fit(resume_from=...). None outside a fit."""
+        ts = self._train_state
+        return None if ts is None else dict(ts)
+
+    def _restore_for_resume(self, directory: str, iterator,
+                            epochs: int):
+        """Load the newest checkpoint in `directory` into this net and
+        prime the mid-epoch replay: restores the iterator's epoch-start
+        state and returns (batches to skip in the first epoch, epochs
+        remaining out of the requested total). An empty/missing
+        directory is a fresh start — the same command line works on
+        first boot and after a preemption."""
+        from deeplearning4j_tpu.train.checkpoint import latest_checkpoint
+        from deeplearning4j_tpu.utils.model_serializer import (
+            restore_fit_state,
+        )
+
+        found = latest_checkpoint(directory)
+        if found is None:
+            logger.info("resume_from=%r: no checkpoint found — starting "
+                        "fresh", directory)
+            return 0, epochs
+        path, _ = found
+        meta = restore_fit_state(self, path)
+        ts = meta.get("train_state") or {}
+        skip = int(ts.get("batch_in_epoch", 0))
+        it_state = ts.get("iterator_state")
+        if it_state is not None:
+            restore = getattr(iterator, "restore_state", None)
+            if callable(restore):
+                restore(it_state)
+            else:
+                logger.warning(
+                    "checkpoint carries iterator state but the iterator "
+                    "has no restore_state(); mid-epoch replay may not be "
+                    "deterministic")
+        remaining = max(0, int(epochs) - int(self.epoch))
+        if remaining == 0 and skip > 0:
+            remaining = 1  # died inside the final epoch: finish it
+        logger.info(
+            "resumed from %s: iteration=%d epoch=%d, replaying %d "
+            "batch(es), %d epoch(s) remaining", path, self.iteration,
+            self.epoch, skip, remaining)
+        _blackbox.get_recorder().record_event(
+            "resume", checkpoint=path, iteration=int(self.iteration),
+            epoch=int(self.epoch), skip_batches=skip)
+        return skip, remaining
+
+    def _fit_epochs(self, iterator, epochs: int, fuse_k: int,
+                    skip_batches: int = 0):
+        skip = int(skip_batches)
         for _ in range(epochs):
             for lst in self.listeners:
                 lst.on_epoch_start(self, self.epoch)
+            # resume coordinates for this epoch: captured BEFORE the
+            # first batch is pulled, so a checkpoint taken anywhere in
+            # the epoch can restore the iterator to the same epoch start
+            # (e.g. the shuffle permutation) and skip forward
+            self._train_state = {
+                "epoch": int(self.epoch),
+                "batch_in_epoch": 0,
+                "iterator_state": self._capture_iterator_state(iterator),
+            }
             t_etl = time.perf_counter()
             buf, sig = [], None
             # data-wait accumulates across buffered (fused) batches so a
@@ -533,6 +635,17 @@ class NetworkBase:
                         ds = self._batch_transform(ds)
                     if self._input_transform is not None:
                         ds = self._input_transform(ds)
+                if skip > 0:
+                    # mid-epoch replay: this batch was trained before the
+                    # checkpoint. It is CONSUMED — pulled through the
+                    # pipeline and transformed, so every stage's rng/step
+                    # counter advances exactly as in the original run —
+                    # but not dispatched (its update is already in the
+                    # restored params).
+                    skip -= 1
+                    self._train_state["batch_in_epoch"] += 1
+                    t_etl = time.perf_counter()
+                    continue
                 if fuse_k > 1:
                     s = self._ds_signature(ds)
                     if buf and s != sig:
@@ -542,7 +655,7 @@ class NetworkBase:
                         flushed, n = list(buf), n_buf
                         self._timed_fit(
                             lambda: self._flush_fused(flushed, fuse_k),
-                            wait_accum, n)
+                            wait_accum, n, n_batches=len(flushed))
                         wait_accum, n_buf = 0.0, 0
                         buf = []
                     wait_accum += wait
@@ -553,7 +666,7 @@ class NetworkBase:
                         flushed, n = list(buf), n_buf
                         self._timed_fit(
                             lambda: self._flush_fused(flushed, fuse_k),
-                            wait_accum, n)
+                            wait_accum, n, n_batches=len(flushed))
                         wait_accum, n_buf = 0.0, 0
                         buf = []
                 else:
@@ -565,7 +678,19 @@ class NetworkBase:
             if buf:
                 flushed, n = list(buf), n_buf
                 self._timed_fit(lambda: self._flush_fused(flushed, fuse_k),
-                                wait_accum, n)
+                                wait_accum, n, n_batches=len(flushed))
+            if skip > 0:
+                # the resumed epoch ended with replay batches still owed:
+                # the iterator yields fewer batches than the checkpoint's
+                # batch_in_epoch said (dataset shrank, batch size grew,
+                # or the iterator state failed to restore). Dropping the
+                # leftover into the NEXT epoch would silently swallow its
+                # first `skip` real batches — reset instead, loudly.
+                logger.warning(
+                    "resume fast-forward ran out of batches with %d still "
+                    "to skip (iterator shorter than at checkpoint time); "
+                    "continuing from the next epoch start", skip)
+                skip = 0
             for lst in self.listeners:
                 lst.on_epoch_end(self, self.epoch)
             self.epoch += 1

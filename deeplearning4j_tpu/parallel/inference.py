@@ -34,6 +34,7 @@ serving-specific mechanisms go beyond it:
 
 from __future__ import annotations
 
+import logging
 import queue
 import threading
 import time
@@ -51,6 +52,7 @@ from deeplearning4j_tpu.parallel.mesh import (
     pad_wrap,
     replicated,
 )
+from deeplearning4j_tpu.utils import blackbox as _blackbox
 from deeplearning4j_tpu.utils import health as _health
 from deeplearning4j_tpu.utils import metrics as _metrics
 from deeplearning4j_tpu.utils import tracing as _tracing
@@ -59,6 +61,8 @@ from deeplearning4j_tpu.utils.concurrency import (
     get_abortable,
     put_abortable,
 )
+
+logger = logging.getLogger("deeplearning4j_tpu")
 
 
 class InferenceMode:
@@ -70,6 +74,17 @@ class RequestValidationError(ValueError):
     """The REQUEST was malformed (empty, or feature shape mismatching the
     endpoint's) — distinguishes client faults from server-side ValueErrors
     so REST layers can map 400 vs 500 correctly."""
+
+
+class ReplicaUnavailable(RuntimeError):
+    """This replica could not take — or had to give back — the request
+    BEFORE its device forward ran: admission after shutdown/abort, or a
+    queued future failed by an eviction sweep. The request never touched
+    the model, so it is safe to resubmit verbatim; ReplicaPool does
+    exactly that on a healthy sibling. Contrast the plain RuntimeError an
+    abort() puts on IN-FLIGHT futures (the group inside the device
+    forward): those may have side effects in flight and are genuinely
+    lost — the only failures the eviction contract lets callers see."""
 
 
 def _queue_depth(ref) -> int:
@@ -101,6 +116,7 @@ class ParallelInference:
         buckets: Optional[Sequence[int]] = None,
         handoff_capacity: int = 2,
         health_stall_after: float = 30.0,
+        component_prefix: str = "serving",
     ):
         self.model = model
         self.mesh = mesh if mesh is not None else data_parallel_mesh()
@@ -139,6 +155,14 @@ class ParallelInference:
         # malformed first request cannot poison the endpoint forever
         self._shape_confirmed = False
         self._shutdown = False
+        # hard-stop flag (abort(), the ReplicaPool eviction path): the
+        # pipeline threads exit at their next queue poll instead of
+        # draining; queued + in-flight futures fail explicitly
+        self._abort = threading.Event()
+        # futures of the group the dispatcher currently holds (set just
+        # before the device forward): the only requests abort() cannot
+        # re-route — they fail, everything else is retriable upstream
+        self._inflight: List[Future] = []
         # _stats is PER-INSTANCE (the JSON /metrics schema: this
         # endpoint's traffic); the registry counters below are
         # process-global aggregates across every ParallelInference in the
@@ -188,12 +212,15 @@ class ParallelInference:
         # /health on the serving layer aggregates exactly this.
         self._hb_collect: Optional[_health.Heartbeat] = None
         self._hb_dispatch: Optional[_health.Heartbeat] = None
+        self.component_prefix = component_prefix
         if self.mode == InferenceMode.BATCHED:
             hreg = _health.get_health()
             self._hb_collect = hreg.register(
-                "serving_collector", stall_after=health_stall_after)
+                f"{component_prefix}_collector",
+                stall_after=health_stall_after)
             self._hb_dispatch = hreg.register(
-                "serving_dispatcher", stall_after=health_stall_after)
+                f"{component_prefix}_dispatcher",
+                stall_after=health_stall_after)
             self._collect_t = threading.Thread(
                 target=self._collector, daemon=True,
                 name="dl4j-serving-collector")
@@ -215,7 +242,8 @@ class ParallelInference:
             # here is visible to shutdown()'s drain, so its Future always
             # resolves (result or explicit shutdown error) — never hangs
             if self._shutdown:
-                raise RuntimeError("ParallelInference has been shut down")
+                raise ReplicaUnavailable(
+                    "ParallelInference has been shut down")
             if xx.shape[0] == 0:
                 # 0 is a multiple of every bucket, so an empty request
                 # would sail through _pad at 0 rows and compile a fresh
@@ -323,6 +351,47 @@ class ParallelInference:
             return
         # post-drain sweep: if a worker died abnormally, fail any stranded
         # Future explicitly instead of hanging its caller forever
+        self._sweep_futures(RuntimeError("ParallelInference shut down"))
+
+    def abort(self, reason: str = "aborted"):
+        """Hard stop — the ReplicaPool eviction path. Unlike shutdown()
+        (which drains: everything queued is still served), abort() stops
+        the pipeline at its next poll and FAILS queued and in-flight
+        futures with a RuntimeError naming `reason`. Callers routing
+        through a ReplicaPool never see those failures — the pool
+        retries admission-level RuntimeErrors on a healthy replica;
+        only requests already inside the device forward are lost, which
+        is exactly the eviction contract (fail only in-flight)."""
+        with self._lock:
+            already = self._shutdown and self._abort.is_set()
+            self._shutdown = True
+        if already:
+            return
+        self._abort.set()
+        for t in (self._collect_t, self._dispatch_t):
+            if t is not None:
+                # a healthy thread exits within one queue poll; a WEDGED
+                # one (the reason for the eviction) is left behind as a
+                # daemon — its heartbeat is unregistered below, so it
+                # cannot re-trip the watchdog
+                t.join(timeout=2.0)
+        # in-flight futures (inside the device forward) are genuinely
+        # lost — non-retryable; everything still QUEUED never ran and
+        # fails retryable, so a pool re-routes it with zero caller-visible
+        # errors
+        err = RuntimeError(f"ParallelInference {reason} (in flight)")
+        for fut in list(self._inflight):
+            if not fut.done():
+                try:
+                    fut.set_exception(err)
+                except Exception:
+                    pass  # lost the race against a completing forward
+        self._sweep_futures(ReplicaUnavailable(f"ParallelInference {reason}"))
+        for hb in (self._hb_collect, self._hb_dispatch):
+            if hb is not None:
+                _health.get_health().unregister(hb)
+
+    def _sweep_futures(self, err: Exception):
         for q in (self._q, self._handoff):
             while True:
                 try:
@@ -333,8 +402,10 @@ class ParallelInference:
                     if item is not None else []
                 for fut in futs:
                     if not fut.done():
-                        fut.set_exception(
-                            RuntimeError("ParallelInference shut down"))
+                        try:
+                            fut.set_exception(err)
+                        except Exception:
+                            pass
 
     # -- internals -----------------------------------------------------------
 
@@ -417,18 +488,25 @@ class ParallelInference:
         """Backpressured put toward the dispatcher. Blocks while the
         device is a full group behind (that IS the backpressure), but
         aborts — failing the group's futures instead of wedging the
-        collector forever — if the dispatcher thread died."""
+        collector forever — if the dispatcher thread died or the
+        pipeline was abort()ed."""
         try:
             put_abortable(
                 self._handoff, item,
-                abort=lambda: (self._dispatch_t is not None
-                               and not self._dispatch_t.is_alive()))
+                abort=lambda: (self._abort.is_set()
+                               or (self._dispatch_t is not None
+                                   and not self._dispatch_t.is_alive())))
             return True
         except QueueAborted:
             for fut in futs:
                 if not fut.done():
-                    fut.set_exception(RuntimeError(
-                        "ParallelInference dispatcher thread died"))
+                    try:
+                        # never dispatched — retryable on another replica
+                        fut.set_exception(ReplicaUnavailable(
+                            "ParallelInference dispatcher unavailable "
+                            "(died or aborted)"))
+                    except Exception:
+                        pass
             return False
 
     # BATCHED pipeline, stage 1: drain + concatenate + pad on the host
@@ -439,12 +517,15 @@ class ParallelInference:
             if pending is not None:
                 item, pending = pending, None
             else:
-                # poll-loop get (no abort predicate: the shutdown
-                # sentinel is the exit protocol — it must drain the queue
-                # in order, so the collector never exits ahead of it).
-                # No busy slot while waiting here: an EMPTY request queue
-                # is idle, not a stall.
-                item = get_abortable(self._q)
+                # poll-loop get (abort predicate: only the hard-stop
+                # flag — the graceful-shutdown sentinel must drain the
+                # queue in order, so the collector never exits ahead of
+                # it). No busy slot while waiting here: an EMPTY request
+                # queue is idle, not a stall.
+                try:
+                    item = get_abortable(self._q, abort=self._abort)
+                except QueueAborted:
+                    return  # abort(): sweep fails whatever is queued
             if item is None:
                 self._put_handoff(None)
                 return
@@ -502,13 +583,15 @@ class ParallelInference:
         while True:
             try:
                 # exits on the collector's sentinel; the abort predicate
-                # covers a collector that died WITHOUT delivering one, so
-                # the dispatcher cannot outlive its feeder
+                # covers the hard stop and a collector that died WITHOUT
+                # delivering one, so the dispatcher cannot outlive its
+                # feeder
                 work = get_abortable(
                     self._handoff,
-                    abort=lambda: (self._collect_t is not None
-                                   and not self._collect_t.is_alive()
-                                   and self._handoff.empty()))
+                    abort=lambda: (self._abort.is_set()
+                                   or (self._collect_t is not None
+                                       and not self._collect_t.is_alive()
+                                       and self._handoff.empty())))
             except QueueAborted:
                 return
             if work is None:
@@ -518,14 +601,375 @@ class ParallelInference:
             # returns (device wedge) leaves this slot stale and the
             # watchdog flips serving_dispatcher to degraded/unhealthy
             with self._hb_dispatch.busy():
+                self._inflight = futs
                 try:
                     out = self._forward_padded(padded, n, b)
                     off = 0
                     for fut, k in zip(futs, sizes):
-                        if not fut.done():  # shutdown sweep may have failed
-                            fut.set_result(self._rows(out, off, off + k))
+                        try:  # abort() may fail the future concurrently
+                            if not fut.done():
+                                fut.set_result(
+                                    self._rows(out, off, off + k))
+                        except Exception:
+                            pass
                         off += k
                 except BaseException as e:  # propagate to waiting callers
                     for fut in futs:
                         if not fut.done():
-                            fut.set_exception(e)
+                            try:
+                                fut.set_exception(e)
+                            except Exception:
+                                pass
+                finally:
+                    self._inflight = []
+
+
+class ReplicaPool:
+    """Self-healing pool of N ParallelInference replicas — the recovery
+    half of the PR 6 health model (reference: ParallelInference.java's
+    worker pool, grown an immune system).
+
+    Each replica registers its collector/dispatcher heartbeats under
+    `<prefix>_r<i>_*`, so the watchdog sees every replica separately. The
+    pool subscribes to health transitions: when any component of replica
+    i flips UNHEALTHY (a dispatcher wedged inside a device forward, a
+    collector blocked against a dead handoff — the PR 6 stall model), a
+    supervisor thread EVICTS the replica (abort(): queued work fails
+    retryable and is re-routed here; only the group already inside the
+    device forward is lost) and RESPAWNS a fresh one under the same
+    component names. Requests route round-robin over in-rotation
+    replicas; a request that lands on a replica mid-eviction comes back
+    as ReplicaUnavailable and is resubmitted on a healthy sibling, so
+    callers never see an error for work that never ran.
+
+    Observable by construction: `serving_replica_evictions_total` /
+    `serving_replica_respawns_total{replica}` counters and the
+    `serving_replicas_in_rotation` gauge live in the shared registry
+    (one /metrics scrape shows the self-healing happening), each
+    eviction/respawn lands in the flight recorder, and the
+    `component_health{component=<prefix>_r<i>_*}` transition history
+    shows the unhealthy→ok cycle.
+
+    `model_factory` (optional) builds a fresh model per spawn — without
+    it every replica shares `model` (one set of replicated params, the
+    TPU-native reading of a "replica": what multiplies is the serving
+    pipeline, not the weights)."""
+
+    def __init__(
+        self,
+        model=None,
+        n_replicas: int = 2,
+        mesh=None,
+        inference_mode: str = InferenceMode.BATCHED,
+        max_batch_size: int = 64,
+        batch_timeout_ms: float = 2.0,
+        buckets: Optional[Sequence[int]] = None,
+        handoff_capacity: int = 2,
+        health_stall_after: float = 30.0,
+        component_prefix: str = "serving",
+        model_factory=None,
+        auto_heal: bool = True,
+        retry_window: float = 5.0,
+    ):
+        if model is None and model_factory is None:
+            raise ValueError("ReplicaPool needs a model or a model_factory")
+        if int(n_replicas) < 1:
+            raise ValueError(f"n_replicas must be >= 1, got {n_replicas}")
+        self.n_replicas = int(n_replicas)
+        self.component_prefix = component_prefix
+        self.auto_heal = bool(auto_heal)
+        self.retry_window = float(retry_window)
+        self._factory = (model_factory if model_factory is not None
+                         else (lambda: model))
+        self._pi_kwargs = dict(
+            mesh=mesh, inference_mode=inference_mode,
+            max_batch_size=int(max_batch_size),
+            batch_timeout_ms=float(batch_timeout_ms), buckets=buckets,
+            handoff_capacity=handoff_capacity,
+            health_stall_after=health_stall_after)
+        self._lock = threading.Lock()
+        self._rr = 0
+        self._gen = [0] * self.n_replicas
+        self._warmup_shape = None
+        self._shutdown = False
+        # THIS pool's lifecycle counts (the registry counters below are
+        # process-global across every pool the process ever built)
+        self._evictions = 0
+        self._respawns = 0
+        reg = _metrics.get_registry()
+        self._m_evict = reg.counter(
+            "serving_replica_evictions_total",
+            "replicas evicted from the pool (unhealthy or explicit)",
+            ("replica",))
+        self._m_respawn = reg.counter(
+            "serving_replica_respawns_total",
+            "replicas respawned into the pool after an eviction",
+            ("replica",))
+        self._m_rerouted = reg.counter(
+            "serving_replica_rerouted_total",
+            "requests retried on a sibling after a retryable replica "
+            "failure (never user-visible)").labels()
+        self._gauge = reg.gauge(
+            "serving_replicas_in_rotation",
+            "replicas currently taking traffic").labels()
+        # slots hold None while a replica is mid-respawn (out of rotation)
+        self._replicas: List[Optional[ParallelInference]] = [None] * \
+            self.n_replicas
+        for i in range(self.n_replicas):
+            self._replicas[i] = self._spawn(i)
+        self._gauge.set(self.n_replicas)
+        # eviction requests flow through a queue to the supervisor: the
+        # health listener fires on the dl4j-watchdog thread, which must
+        # never block on an abort()'s thread joins
+        self._evict_q: "queue.Queue" = queue.Queue()
+        self._stop = threading.Event()
+        self._supervisor = threading.Thread(
+            target=self._supervise, daemon=True,
+            name=f"dl4j-replica-supervisor-{component_prefix}")
+        self._supervisor.start()
+        _health.get_health().add_listener(self._on_health_transition)
+
+    # -- spawning / routing ---------------------------------------------------
+
+    def _prefix(self, idx: int) -> str:
+        return f"{self.component_prefix}_r{idx}"
+
+    def _spawn(self, idx: int) -> ParallelInference:
+        pi = ParallelInference(self._factory(),
+                               component_prefix=self._prefix(idx),
+                               **self._pi_kwargs)
+        if self._warmup_shape is not None:
+            try:
+                pi.warmup(self._warmup_shape)
+            except Exception:
+                logger.exception("replica %d warmup failed (serving "
+                                 "anyway; first requests pay the compile)",
+                                 idx)
+        return pi
+
+    def _pick(self) -> Optional[ParallelInference]:
+        with self._lock:
+            if self._shutdown:
+                raise RuntimeError("ReplicaPool has been shut down")
+            for _ in range(self.n_replicas):
+                idx = self._rr % self.n_replicas
+                self._rr += 1
+                pi = self._replicas[idx]
+                if pi is not None:
+                    return pi
+        return None
+
+    def output(self, x):
+        """Thread-safe inference with failover: retryable replica
+        failures (eviction races, mid-respawn gaps) are resubmitted on a
+        healthy sibling inside `retry_window`; only non-retryable
+        failures — a group already inside a device forward at eviction
+        time, or a genuine model error — reach the caller."""
+        deadline = time.monotonic() + self.retry_window
+        last: Optional[Exception] = None
+        while True:
+            pi = self._pick()
+            if pi is None:
+                last = last or RuntimeError("no replica in rotation")
+            else:
+                try:
+                    return pi.output(x)
+                except RequestValidationError:
+                    raise  # the client's fault on ANY replica
+                except ReplicaUnavailable as e:
+                    last = e
+                    self._m_rerouted.inc()
+            if time.monotonic() >= deadline:
+                raise RuntimeError(
+                    f"no healthy replica within {self.retry_window:.1f}s"
+                ) from last
+            # a respawn is at most an abort-join + constructor away;
+            # breathe instead of spinning the admission lock
+            time.sleep(0.005)
+
+    def warmup(self, feature_shape: Optional[Sequence[int]] = None,
+               dtype=np.float32):
+        """Precompile every bucket on every replica; the shape is kept so
+        respawned replicas warm themselves before re-entering rotation."""
+        with self._lock:
+            replicas = [pi for pi in self._replicas if pi is not None]
+        for pi in replicas:
+            pi.warmup(feature_shape, dtype)
+        if feature_shape is not None:
+            self._warmup_shape = tuple(feature_shape)
+        elif replicas and replicas[0]._expected_shape is not None:
+            self._warmup_shape = replicas[0]._expected_shape
+        return self
+
+    # -- self-healing ---------------------------------------------------------
+
+    def _on_health_transition(self, tr: dict):
+        if tr.get("to") != _health.UNHEALTHY or self._shutdown:
+            return
+        comp = tr.get("component", "")
+        for idx in range(self.n_replicas):
+            if comp.startswith(self._prefix(idx) + "_"):
+                self.request_eviction(
+                    idx, reason=f"{comp} unhealthy "
+                    f"({tr.get('stalled_for_seconds')}s stall)")
+                return
+
+    def request_eviction(self, idx: int, reason: str):
+        """Queue an eviction for the supervisor thread (safe from any
+        thread, including the watchdog's transition callback). The
+        replica's CURRENT generation rides along: two components of one
+        wedged replica both flipping UNHEALTHY queue two requests, and
+        the stale second one must not evict the healthy respawn the
+        first one produced."""
+        idx = int(idx)
+        with self._lock:
+            gen = self._gen[idx]
+        self._evict_q.put_nowait((idx, gen, reason))
+
+    def _supervise(self):
+        while True:
+            try:
+                idx, gen, reason = get_abortable(self._evict_q, self._stop)
+            except QueueAborted:
+                return
+            try:
+                self.evict(idx, reason, if_generation=gen)
+            except Exception:
+                logger.exception("replica %d eviction failed", idx)
+
+    def evict(self, idx: int, reason: str = "evicted",
+              if_generation: Optional[int] = None):
+        """Take replica `idx` out of rotation, abort it (queued work
+        fails retryable and re-routes; only in-flight work is lost), and
+        — under auto_heal — respawn a fresh replica into the slot.
+        `if_generation` makes the eviction conditional: a no-op when the
+        slot has already been respawned past that generation."""
+        with self._lock:
+            pi = self._replicas[idx]
+            if pi is None or self._shutdown:
+                return  # already mid-respawn, or shutting down
+            if if_generation is not None and self._gen[idx] != if_generation:
+                logger.info(
+                    "replica %d eviction request for gen %d is stale "
+                    "(slot is at gen %d) — skipping", idx, if_generation,
+                    self._gen[idx])
+                return
+            self._replicas[idx] = None
+            self._gen[idx] += 1
+            gen = self._gen[idx]
+        self._gauge.set(self._in_rotation())
+        with self._lock:
+            self._evictions += 1
+        self._m_evict.labels(str(idx)).inc()
+        _blackbox.get_recorder().record_event(
+            "replica_evicted", replica=idx, generation=gen, reason=reason)
+        logger.warning("replica %d evicted (gen %d): %s", idx, gen, reason)
+        pi.abort(f"replica {idx} evicted: {reason}")
+        if not self.auto_heal or self._shutdown:
+            return
+        fresh = self._spawn(idx)
+        with self._lock:
+            if self._shutdown:
+                fresh.abort("pool shut down during respawn")
+                return
+            self._replicas[idx] = fresh
+        self._gauge.set(self._in_rotation())
+        with self._lock:
+            self._respawns += 1
+        self._m_respawn.labels(str(idx)).inc()
+        _blackbox.get_recorder().record_event(
+            "replica_respawned", replica=idx, generation=gen)
+        logger.info("replica %d respawned (gen %d)", idx, gen)
+
+    def _in_rotation(self) -> int:
+        with self._lock:
+            return sum(1 for pi in self._replicas if pi is not None)
+
+    # -- introspection / lifecycle -------------------------------------------
+
+    @property
+    def model(self):
+        with self._lock:
+            for pi in self._replicas:
+                if pi is not None:
+                    return pi.model
+        return None
+
+    @property
+    def buckets(self) -> List[int]:
+        with self._lock:
+            for pi in self._replicas:
+                if pi is not None:
+                    return list(pi.buckets)
+        return []
+
+    @property
+    def _expected_shape(self):
+        # duck-typing for InferenceServer's /health feature_shape field
+        with self._lock:
+            for pi in self._replicas:
+                if pi is not None and pi._expected_shape is not None:
+                    return pi._expected_shape
+        return self._warmup_shape
+
+    def metrics(self) -> dict:
+        """Pool-aggregated serving counters in the ParallelInference
+        schema (requests/examples/batches/bucket_hits summed over live
+        replicas), plus the pool's own lifecycle numbers and a
+        per-replica breakdown."""
+        with self._lock:
+            replicas = list(self._replicas)
+            gens = list(self._gen)
+        per, agg = [], None
+        for idx, pi in enumerate(replicas):
+            if pi is None:
+                per.append({"replica": idx, "generation": gens[idx],
+                            "in_rotation": False})
+                continue
+            m = pi.metrics()
+            per.append({"replica": idx, "generation": gens[idx],
+                        "in_rotation": True, "requests": m["requests"],
+                        "examples": m["examples"], "batches": m["batches"],
+                        "queue_depth": m["queue_depth"]})
+            if agg is None:
+                agg = m
+            else:
+                for k in ("requests", "examples", "batches", "oversized"):
+                    agg[k] += m[k]
+                for b, v in m["bucket_hits"].items():
+                    agg["bucket_hits"][b] = agg["bucket_hits"].get(b, 0) + v
+                agg["queue_depth"] += m["queue_depth"]
+                agg["forward_compiles"] = max(agg["forward_compiles"],
+                                              m["forward_compiles"])
+        if agg is None:  # every slot mid-respawn: still a valid scrape
+            agg = {"mode": self._pi_kwargs["inference_mode"], "requests": 0,
+                   "examples": 0, "batches": 0, "oversized": 0,
+                   "bucket_hits": {}, "buckets": [],
+                   "max_batch_size": self._pi_kwargs["max_batch_size"],
+                   "batch_timeout_ms":
+                       self._pi_kwargs["batch_timeout_ms"],
+                   "queue_depth": 0, "forward_compiles": 0}
+        agg["replicas"] = per
+        agg["n_replicas"] = self.n_replicas
+        agg["in_rotation"] = sum(1 for pi in replicas if pi is not None)
+        with self._lock:
+            agg["evictions"] = self._evictions
+            agg["respawns"] = self._respawns
+        return agg
+
+    def shutdown(self):
+        """Graceful: drain every replica (queued work is served), stop
+        the supervisor, unsubscribe from health transitions."""
+        with self._lock:
+            if self._shutdown:
+                return
+            self._shutdown = True
+            replicas = list(self._replicas)
+            self._replicas = [None] * self.n_replicas
+        _health.get_health().remove_listener(self._on_health_transition)
+        self._stop.set()
+        self._supervisor.join(timeout=10)
+        for pi in replicas:
+            if pi is not None:
+                pi.shutdown()
+        self._gauge.set(0)

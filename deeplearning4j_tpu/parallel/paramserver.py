@@ -35,11 +35,13 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import queue
 import struct
 import threading
 import time
 import urllib.request
+from collections import deque
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -48,7 +50,6 @@ from deeplearning4j_tpu.utils import health as _health
 from deeplearning4j_tpu.utils import metrics as _metrics
 from deeplearning4j_tpu.utils.concurrency import (
     QueueAborted,
-    get_abortable,
     put_abortable,
 )
 from deeplearning4j_tpu.utils.jsonhttp import JsonHttpServer, json_response
@@ -110,13 +111,32 @@ def _unpack_rows(body: bytes) -> np.ndarray:
 
 
 class EmbeddingParameterServer:
-    """One shard-owner process. Tables are {name: [rows, dim]} float32."""
+    """One shard-owner process. Tables are {name: [rows, dim]} float32.
 
-    def __init__(self, tables: Dict[str, np.ndarray], port: int = 0):
+    `journal_dir` arms crash durability: every push is appended to a
+    write-ahead journal (`journal.bin`, length-prefixed binary push
+    records — the wire format, reused) BEFORE it is applied, and
+    `snapshot()` persists the tables (`tables.npz`, atomic rename) and
+    truncates the journal. A restarted server pointed at the same
+    directory restores snapshot + replays the journal tail, so a shard
+    owner dying mid-run costs nothing but the restart window — the
+    client's replay buffer covers that (EmbeddingPSClient). A torn final
+    journal record (killed mid-append) is detected by its length prefix
+    and discarded; everything before it replays. `snapshot_every` > 0
+    auto-snapshots after that many pushes, bounding replay time."""
+
+    def __init__(self, tables: Dict[str, np.ndarray], port: int = 0,
+                 journal_dir: Optional[str] = None,
+                 snapshot_every: int = 0):
         self.tables = {k: np.asarray(v, np.float32) for k, v in tables.items()}
         self._locks = {k: threading.Lock() for k in self.tables}
         self._server = JsonHttpServer(post=self._post, port=port)
         self.pushes_applied = 0
+        self.journal_dir = journal_dir
+        self.snapshot_every = int(snapshot_every)
+        self._journal = None
+        self._jlock = threading.Lock()
+        self._since_snapshot = 0
         # RPC counters + latency histograms in the shared registry, by
         # route — the PS hot path (pull.bin/push.bin) becomes a series an
         # operator can alert on instead of a private attribute
@@ -127,10 +147,141 @@ class EmbeddingParameterServer:
         self._m_rpc_sec = reg.histogram(
             "paramserver_rpc_seconds", "parameter-server RPC service time",
             ("route",))
+        self._m_journal = reg.counter(
+            "paramserver_journal_records_total",
+            "pushes appended to the write-ahead journal").labels()
+        self._m_replayed = reg.counter(
+            "paramserver_journal_replayed_total",
+            "journaled pushes re-applied on restart").labels()
+        self._m_snapshots = reg.counter(
+            "paramserver_snapshots_total",
+            "table snapshots persisted (journal truncations)").labels()
+        if journal_dir is not None:
+            self._restore_from_dir()
 
     @property
     def port(self) -> int:
         return self._server.port
+
+    # -- durability -----------------------------------------------------------
+
+    def _snapshot_path(self) -> str:
+        return os.path.join(self.journal_dir, "tables.npz")
+
+    def _journal_path(self) -> str:
+        return os.path.join(self.journal_dir, "journal.bin")
+
+    def _restore_from_dir(self):
+        os.makedirs(self.journal_dir, exist_ok=True)
+        snap = self._snapshot_path()
+        if os.path.exists(snap):
+            with np.load(snap) as npz:
+                for name in npz.files:
+                    if name not in self.tables:
+                        raise ValueError(
+                            f"snapshot table {name!r} unknown to this "
+                            f"server (have {sorted(self.tables)})")
+                    if npz[name].shape != self.tables[name].shape:
+                        raise ValueError(
+                            f"snapshot table {name!r} shape "
+                            f"{npz[name].shape} != configured "
+                            f"{self.tables[name].shape}")
+                    self.tables[name] = npz[name].astype(np.float32)
+        replayed = 0
+        jpath = self._journal_path()
+        if os.path.exists(jpath):
+            with open(jpath, "rb") as f:
+                buf = f.read()
+            off = 0
+            while off + 4 <= len(buf):
+                (rec_len,) = struct.unpack_from("<I", buf, off)
+                if off + 4 + rec_len > len(buf):
+                    logger.warning(
+                        "journal ends in a torn record (%d of %d bytes) — "
+                        "a writer died mid-append; discarding the tail",
+                        len(buf) - off - 4, rec_len)
+                    break
+                name, rows, deltas = _unpack_request(
+                    buf[off + 4:off + 4 + rec_len])
+                # same contract as the snapshot branch above: a journal
+                # written by a differently-configured server fails with
+                # a descriptive error, not a raw KeyError/IndexError
+                if name not in self.tables:
+                    raise ValueError(
+                        f"journal record #{replayed} targets table "
+                        f"{name!r} unknown to this server "
+                        f"(have {sorted(self.tables)})")
+                table = self.tables[name]
+                if rows.size and (int(rows.max()) >= table.shape[0]
+                                  or int(rows.min()) < 0):
+                    raise ValueError(
+                        f"journal record #{replayed} for table {name!r} "
+                        f"addresses row {int(rows.max())} outside the "
+                        f"configured shape {table.shape}")
+                if deltas.shape[1:] != table.shape[1:]:
+                    raise ValueError(
+                        f"journal record #{replayed} for table {name!r} "
+                        f"has row dim {deltas.shape[1:]} != configured "
+                        f"{table.shape[1:]}")
+                self._apply(name, rows.tolist(), deltas)
+                replayed += 1
+                off += 4 + rec_len
+            if off != len(buf) and off + 4 > len(buf):
+                logger.warning("journal ends mid-length-prefix; "
+                               "discarding the tail")
+        if replayed:
+            self._m_replayed.inc(replayed)
+            logger.info("paramserver restored: replayed %d journaled "
+                        "push(es) from %s", replayed, jpath)
+        self._journal = open(jpath, "ab")
+
+    def snapshot(self) -> str:
+        """Persist the tables and truncate the journal — the recovery
+        point moves to NOW. Atomic: readers of the directory never see a
+        half-written snapshot (tmp + rename), and the journal is only
+        truncated after the snapshot is durable."""
+        if self.journal_dir is None:
+            raise ValueError("server was built without journal_dir")
+        with self._jlock:
+            copies = {}
+            for name in sorted(self.tables):
+                with self._locks[name]:
+                    copies[name] = self.tables[name].copy()
+            path = self._snapshot_path()
+            tmp = f"{path}.{os.getpid()}.tmp"
+            np.savez(tmp, **copies)
+            # np.savez appends .npz when missing — normalize
+            tmp_real = tmp if os.path.exists(tmp) else tmp + ".npz"
+            os.replace(tmp_real, path)
+            if self._journal is not None:
+                self._journal.close()
+            self._journal = open(self._journal_path(), "wb")
+            self._since_snapshot = 0
+        self._m_snapshots.inc()
+        logger.info("paramserver snapshot: %s", path)
+        return path
+
+    def _journal_push(self, name: str, rows, deltas: np.ndarray) -> bool:
+        """Journal the push and apply it under ONE _jlock hold, so a
+        concurrent snapshot() (which also takes _jlock) can never copy
+        tables missing a delta whose journal record it is about to
+        truncate. Returns True when an auto-snapshot is due — taken by
+        the caller AFTER the apply, so the triggering push is in the
+        snapshot it causes."""
+        payload = _pack_request(name, np.asarray(rows, np.int64),
+                                np.asarray(deltas, np.float32))
+        with self._jlock:
+            if self._journal is None:  # closed (stop()): apply-only
+                self._apply(name, rows, deltas)
+                return False
+            self._journal.write(struct.pack("<I", len(payload)) + payload)
+            self._journal.flush()
+            self._apply(name, rows, deltas)
+            self._since_snapshot += 1
+            due = (self.snapshot_every > 0
+                   and self._since_snapshot >= self.snapshot_every)
+        self._m_journal.inc()
+        return due
 
     # -- core ops ------------------------------------------------------------
 
@@ -138,11 +289,21 @@ class EmbeddingParameterServer:
         with self._locks[name]:
             return self.tables[name][rows].copy()
 
-    def push(self, name: str, rows: List[int], deltas: np.ndarray) -> None:
-        """Apply row deltas in arrival order (async SGD)."""
+    def _apply(self, name: str, rows: List[int], deltas: np.ndarray) -> None:
         with self._locks[name]:
             np.add.at(self.tables[name], rows, deltas)
             self.pushes_applied += 1
+
+    def push(self, name: str, rows: List[int], deltas: np.ndarray) -> None:
+        """Apply row deltas in arrival order (async SGD). Journaled
+        BEFORE application when durability is armed — a crash between
+        the two re-applies the delta on restart, which async-SGD
+        semantics tolerate (at-least-once beats silent loss)."""
+        if self.journal_dir is not None:
+            if self._journal_push(name, rows, deltas):
+                self.snapshot()
+            return
+        self._apply(name, rows, deltas)
 
     # -- http transport ------------------------------------------------------
 
@@ -185,6 +346,10 @@ class EmbeddingParameterServer:
 
     def stop(self):
         self._server.stop()
+        with self._jlock:
+            if self._journal is not None:
+                self._journal.close()
+                self._journal = None
 
 
 class EmbeddingPSClient:
@@ -194,17 +359,33 @@ class EmbeddingPSClient:
     little-endian rows (see _pack_request) — JSON would be ~10x the bytes
     for real [vocab, dim] tables.
 
-    `dropped_pushes` counts push batches lost to dead/misbehaving
-    endpoints — training degrades (loses some async gradient mass)
-    rather than hanging, and the loss is observable instead of silent."""
+    Failover: every RPC retries with bounded exponential backoff
+    (`max_retries`/`retry_backoff`), and a push whose endpoint stays
+    down after the retries is PARKED in a per-endpoint FIFO replay
+    buffer (`replay_capacity` batches) instead of dropped — the drain
+    thread re-attempts parked pushes before any newer work for that
+    endpoint, so a restarted server (journal-backed, see
+    EmbeddingParameterServer) receives every batch in order and the run
+    converges. Only replay-buffer OVERFLOW drops, and `dropped_pushes` /
+    `paramserver_client_push_dropped_total` still count every loss —
+    degradation stays observable, never silent. `replay_capacity=0`
+    restores the old drop-immediately behavior."""
 
     def __init__(self, urls: List[str], queue_size: int = 64,
-                 timeout: float = 10.0):
+                 timeout: float = 10.0, max_retries: int = 2,
+                 retry_backoff: float = 0.05,
+                 replay_capacity: int = 128):
         self.urls = [u.rstrip("/") for u in urls]
         self.timeout = timeout
+        self.max_retries = max(0, int(max_retries))
+        self.retry_backoff = float(retry_backoff)
+        self.replay_capacity = max(0, int(replay_capacity))
         self.dropped_pushes = 0
         self._dims: Dict[str, int] = {}
         self._q: "queue.Queue" = queue.Queue(maxsize=queue_size)
+        # per-endpoint parked pushes, FIFO; drain-thread-only once the
+        # worker is running (close() touches it only after the join)
+        self._pending: List[deque] = [deque() for _ in self.urls]
         reg = _metrics.get_registry()
         self._m_rpc = reg.counter(
             "paramserver_client_rpc_total",
@@ -215,6 +396,14 @@ class EmbeddingPSClient:
         self._m_dropped = reg.counter(
             "paramserver_client_push_dropped_total",
             "push batches lost to dead/misbehaving endpoints").labels()
+        self._m_retries = reg.counter(
+            "paramserver_client_retry_total",
+            "RPC attempts beyond the first (endpoint flaky/down)",
+            ("route",))
+        self._m_replayed = reg.counter(
+            "paramserver_client_push_replayed_total",
+            "parked pushes delivered after their endpoint came back"
+        ).labels()
         self._stop = threading.Event()
         # liveness: the drain holds a busy slot only while delivering a
         # push batch — a wedged endpoint (socket past its timeout, DNS
@@ -242,6 +431,25 @@ class EmbeddingPSClient:
             self._m_rpc.labels(label).inc()
             self._m_rpc_sec.labels(label).observe(time.perf_counter() - t0)
 
+    def _post_with_retry(self, url: str, route: str,
+                         payload: bytes) -> bytes:
+        """`_post_bin` with bounded exponential backoff — a blip (server
+        restart, transient network fault) costs latency, not data. The
+        final failure propagates; push callers park the payload for
+        replay, pull callers surface it (the step needs the rows NOW)."""
+        label = route.lstrip("/")
+        attempt = 0
+        while True:
+            try:
+                return self._post_bin(url, route, payload)
+            except Exception:
+                if attempt >= self.max_retries or self._stop.is_set():
+                    raise
+                self._m_retries.labels(label).inc()
+                # stop-aware sleep: a close() mid-backoff aborts the wait
+                self._stop.wait(self.retry_backoff * (2 ** attempt))
+                attempt += 1
+
     def _dim(self, table: str) -> int:
         """Table dim, cached from the first shard's /meta (needed to shape
         empty pulls)."""
@@ -264,7 +472,7 @@ class EmbeddingPSClient:
             sel = np.nonzero(rows % len(self.urls) == s)[0]
             if sel.size == 0:
                 continue
-            got = _unpack_rows(self._post_bin(
+            got = _unpack_rows(self._post_with_retry(
                 url, "/pull.bin", _pack_request(table, rows[sel])))
             if out is None:
                 out = np.zeros((rows.size, got.shape[1]), np.float32)
@@ -307,44 +515,105 @@ class EmbeddingPSClient:
 
     def close(self):
         """Stop accepting pushes and retire the drain thread. Pushes
-        already queued are still delivered (get_abortable drains the
-        queue before honoring the stop), so close() waits up to ~10s;
-        against a dead endpoint delivery can outlast the join timeout —
-        the daemon thread then finishes (or dies) on its own."""
+        already queued are still delivered (queued items win over the
+        stop flag), so close() waits up to ~10s; against a dead endpoint
+        delivery can outlast the join timeout — the daemon thread then
+        finishes (or dies) on its own. Parked pushes get one last
+        single-shot delivery attempt; whatever still cannot land is
+        accounted as dropped — a closing client must not pretend parked
+        work will ever flush."""
         self._stop.set()
         self._worker.join(timeout=10)
+        if not self._worker.is_alive():
+            self._flush_pending()
+            for s, pend in enumerate(self._pending):
+                while pend:
+                    pend.popleft()
+                    self._count_drop(
+                        f"client closed with endpoint {s} still down")
         _health.get_health().unregister(self._hb)
+
+    def _count_drop(self, why):
+        self.dropped_pushes += 1
+        self._m_dropped.inc()
+        logger.warning("PS push dropped (%d total): %s",
+                       self.dropped_pushes, why)
+
+    def _deliver(self, table: str, rows: np.ndarray, deltas: np.ndarray):
+        """Route one push batch: per owning shard, the payload joins that
+        endpoint's FIFO (behind anything parked from an outage — arrival
+        order per shard is preserved) and the FIFO is flushed head-first."""
+        for s in range(len(self.urls)):
+            sel = np.nonzero(rows % len(self.urls) == s)[0]
+            if sel.size == 0:
+                continue
+            # [payload, failed_before]: the flag turns a later delivery
+            # into a counted replay
+            self._pending[s].append(
+                [_pack_request(table, rows[sel], deltas[sel]), False])
+            self._flush_endpoint(s)
+
+    def _flush_endpoint(self, s: int):
+        pend = self._pending[s]
+        while pend:
+            rec = pend[0]
+            try:
+                self._post_with_retry(self.urls[s], "/push.bin", rec[0])
+            except Exception as e:
+                rec[1] = True
+                if self.replay_capacity == 0:
+                    # failover disabled: the old drop-and-move-on path
+                    pend.popleft()
+                    self._count_drop(e)
+                elif len(pend) > self.replay_capacity:
+                    # overflow evicts the OLDEST parked push (its loss is
+                    # the least stale) — and is the ONLY way a push is
+                    # lost while the client lives
+                    pend.popleft()
+                    self._count_drop(
+                        f"replay buffer full ({self.replay_capacity}) "
+                        f"while endpoint {s} is down: {e}")
+                return
+            pend.popleft()
+            if rec[1]:
+                self._m_replayed.inc()
+
+    def _flush_pending(self):
+        for s in range(len(self.urls)):
+            if self._pending[s]:
+                self._flush_endpoint(s)
 
     def _drain(self):
         while True:
             try:
-                table, rows, deltas = get_abortable(self._q, self._stop)
-            except QueueAborted:
-                return
+                # timeout-ful get doubles as the retry tick: while the
+                # producer is quiet, parked pushes get re-attempted, so
+                # a recovered endpoint converges without new traffic
+                item = self._q.get(timeout=0.25)
+            except queue.Empty:
+                if self._stop.is_set():
+                    return
+                if any(self._pending):
+                    with self._hb.busy():
+                        self._flush_pending()
+                continue
             try:
                 with self._hb.busy():
-                    for s, url in enumerate(self.urls):
-                        sel = np.nonzero(rows % len(self.urls) == s)[0]
-                        if sel.size == 0:
-                            continue
-                        self._post_bin(url, "/push.bin",
-                                       _pack_request(table, rows[sel],
-                                                     deltas[sel]))
-            except Exception as e:
-                # endpoint down or reply malformed: drop THIS push and keep
-                # the drain thread alive — a dead thread would silently
-                # wedge push_async once the bounded queue fills
-                self.dropped_pushes += 1
-                self._m_dropped.inc()
-                logger.warning("PS push dropped (%d total): %s",
-                               self.dropped_pushes, e)
+                    self._deliver(*item)
             finally:
                 self._q.task_done()
 
     def flush(self, timeout: float = 30.0):
+        """Wait for the QUEUED pushes to be attempted. Parked pushes
+        (endpoint down) are excluded — they wait for the endpoint, not
+        for this call; `pending_pushes()` exposes them."""
         import time
 
         deadline = time.monotonic() + timeout
         while not self._q.empty() and time.monotonic() < deadline:
             time.sleep(0.02)
         self._q.join()
+
+    def pending_pushes(self) -> int:
+        """Push payloads parked for replay across all endpoints."""
+        return sum(len(p) for p in self._pending)

@@ -45,6 +45,7 @@ import numpy as np
 from deeplearning4j_tpu.parallel.inference import (
     InferenceMode,
     ParallelInference,
+    ReplicaPool,
     RequestValidationError,
 )
 from deeplearning4j_tpu.utils import health as _health
@@ -68,11 +69,29 @@ class InferenceServer:
         buckets: Optional[Sequence[int]] = None,
         warmup_shape: Optional[Sequence[int]] = None,
         health_stall_after: float = 30.0,
+        n_replicas: int = 1,
     ):
-        self.inference = ParallelInference(
-            model, mesh, inference_mode, max_batch_size, batch_timeout_ms,
-            buckets, health_stall_after=health_stall_after,
-        )
+        # n_replicas >= 2 turns on the self-healing pool: each replica's
+        # collector/dispatcher heartbeats are watched separately, an
+        # unhealthy replica is evicted (only its in-flight requests fail;
+        # queued work re-routes to a sibling with no user-visible error)
+        # and respawned — the eviction/respawn cycle shows up in
+        # component_health transitions and serving_replica_* counters on
+        # the same /metrics scrape as the traffic series
+        if int(n_replicas) > 1:
+            self.inference = ReplicaPool(
+                model, n_replicas=int(n_replicas), mesh=mesh,
+                inference_mode=inference_mode,
+                max_batch_size=max_batch_size,
+                batch_timeout_ms=batch_timeout_ms, buckets=buckets,
+                health_stall_after=health_stall_after,
+            )
+        else:
+            self.inference = ParallelInference(
+                model, mesh, inference_mode, max_batch_size,
+                batch_timeout_ms, buckets,
+                health_stall_after=health_stall_after,
+            )
         if warmup_shape is not None:
             self.inference.warmup(warmup_shape)
         self.latency = LatencyTracker()
@@ -210,6 +229,9 @@ def main(argv=None):
     ap.add_argument("--warmupShape", default=None,
                     help="comma-separated feature shape to precompile all "
                          "buckets before the port opens, e.g. 784 or 28,28,1")
+    ap.add_argument("--replicas", type=int, default=1,
+                    help=">=2 serves through a self-healing ReplicaPool: "
+                         "unhealthy replicas are evicted and respawned")
     args = ap.parse_args(argv)
     from deeplearning4j_tpu.cli import guess_and_load_model
 
@@ -221,7 +243,7 @@ def main(argv=None):
     server = InferenceServer(
         model, port=args.port, max_batch_size=args.maxBatchSize,
         batch_timeout_ms=args.batchTimeoutMs, buckets=buckets,
-        warmup_shape=warmup,
+        warmup_shape=warmup, n_replicas=args.replicas,
     )
     # operator surface: opt in to real log output, then announce through
     # the package logger (library code never prints — lint CC006)

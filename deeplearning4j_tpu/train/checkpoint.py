@@ -9,10 +9,22 @@ that saves on SIGTERM and resumes from the newest checkpoint loses at
 most one save interval.
 
     listener = CheckpointListener("ckpts/", every_n_iterations=500,
-                                  keep_last=3, save_on_preemption=True)
+                                  keep_last=3, save_on_preemption=True,
+                                  async_save=True)
     net.set_listeners(listener)
     ...
     net2, meta = CheckpointListener.restore_latest("ckpts/")
+    # or, continuing an existing object with mid-epoch replay:
+    net.fit(iterator, epochs=E, resume_from="ckpts/")
+
+`async_save=True` splits a save into the two costs
+utils.model_serializer.ModelSnapshot separates: the fit thread only
+CAPTURES (reference grabs — the blocking `snapshot` phase of the
+`checkpoint_save_seconds{phase=...}` histogram) and a `dl4j-ckpt-writer`
+daemon does the serialize/compress/rename (`write` phase), so a save no
+longer stalls the step loop. Every checkpoint also carries the net's
+TrainState (iteration/epoch + iterator position) for byte-identical
+mid-epoch resume; see nn/netbase.py.
 """
 
 from __future__ import annotations
@@ -20,16 +32,102 @@ from __future__ import annotations
 import json
 import logging
 import os
-import signal
+import queue
 import threading
 import time
-from typing import Optional, Tuple
+from typing import List, Optional, Tuple
 
 from deeplearning4j_tpu.train.listeners import IterationListener
+from deeplearning4j_tpu.utils import blackbox as _blackbox
+from deeplearning4j_tpu.utils import health as _health
 from deeplearning4j_tpu.utils import metrics as _metrics
+from deeplearning4j_tpu.utils import sigchain as _sigchain
 from deeplearning4j_tpu.utils import tracing as _tracing
+from deeplearning4j_tpu.utils.concurrency import QueueAborted, get_abortable
 
 logger = logging.getLogger("deeplearning4j_tpu")
+
+_LATEST = "latest.json"
+
+
+def scan_checkpoints(directory: str) -> List[Tuple[int, str]]:
+    """(iteration, filename) for every complete checkpoint zip in
+    `directory`, ascending by iteration — the metadata-independent view
+    (in-flight `*.tmp` writes never appear: the atomic rename publishes
+    a zip only once it is whole)."""
+    out = []
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return out
+    for f in names:
+        if f.startswith("checkpoint_iter") and f.endswith(".zip"):
+            try:
+                out.append((int(f[len("checkpoint_iter"):-len(".zip")]), f))
+            except ValueError:
+                continue
+    return sorted(out)
+
+
+def latest_checkpoint(directory: str) -> Optional[Tuple[str, dict]]:
+    """(path, meta) of the newest checkpoint, or None when the directory
+    holds none. Prefers `latest.json`, but a missing, torn (crash
+    mid-write) or dangling metadata file degrades to scanning the
+    checkpoint zips newest-first and reading each zip's own meta — the
+    metadata is an accelerator, never a single point of failure."""
+    meta_path = os.path.join(directory, _LATEST)
+    try:
+        with open(meta_path) as f:
+            meta = json.load(f)
+        path = os.path.join(directory, meta["file"])
+        if os.path.exists(path):
+            return path, meta
+        logger.warning("checkpoint metadata points at missing %r; "
+                       "falling back to a directory scan", meta["file"])
+    except FileNotFoundError:
+        pass
+    except (OSError, ValueError, KeyError, json.JSONDecodeError):
+        logger.warning("torn/unreadable %s in %r; falling back to a "
+                       "directory scan", _LATEST, directory)
+    import zipfile
+
+    for it, name in reversed(scan_checkpoints(directory)):
+        path = os.path.join(directory, name)
+        try:
+            with zipfile.ZipFile(path) as zf:
+                zmeta = json.loads(zf.read("meta.json").decode("utf-8"))
+        except Exception:
+            logger.warning("skipping unreadable checkpoint %r", name)
+            continue
+        meta = {
+            "iteration": int(zmeta.get("iteration", it)),
+            "epoch": int(zmeta.get("epoch", 0)),
+            "ts": os.path.getmtime(path),
+            "reason": "scan",  # recovered without metadata
+            "file": name,
+        }
+        return path, meta
+    return None
+
+
+def describe_latest(directory: str) -> Optional[dict]:
+    """Operator view of the newest checkpoint (cli resume): meta plus
+    age and absolute path. None when the directory holds none."""
+    found = latest_checkpoint(directory)
+    if found is None:
+        return None
+    path, meta = found
+    out = dict(meta)
+    out["path"] = path
+    ts = meta.get("ts")
+    out["age_seconds"] = None if ts is None else max(0.0, time.time() - ts)
+    from deeplearning4j_tpu.utils.model_serializer import read_train_state
+
+    try:
+        out["train_state"] = read_train_state(path)
+    except Exception:
+        out["train_state"] = None
+    return out
 
 
 class CheckpointListener(IterationListener):
@@ -38,9 +136,15 @@ class CheckpointListener(IterationListener):
     every_n_iterations / every_n_epochs / every_n_seconds: any
     combination; a save fires when any schedule is due.
     keep_last: retain the newest N checkpoints (0 = keep all).
-    save_on_preemption: install a SIGTERM handler that saves
-    synchronously before re-raising the default handler (the TPU/GCE
-    preemption contract)."""
+    save_on_preemption: register a SIGTERM action (utils/sigchain, at
+    PRIORITY_SAVE — always before the flight recorder's crash dump) that
+    saves synchronously before the process dies (the TPU/GCE preemption
+    contract).
+    async_save: the fit thread only snapshots (device references); a
+    `dl4j-ckpt-writer` daemon serializes and renames in the background.
+    At most `queue_depth` snapshots wait; when the writer falls behind,
+    the OLDEST queued snapshot is coalesced away (counted) — the newest
+    state always wins."""
 
     def __init__(self, directory: str, *,
                  every_n_iterations: Optional[int] = None,
@@ -48,7 +152,9 @@ class CheckpointListener(IterationListener):
                  every_n_seconds: Optional[float] = None,
                  keep_last: int = 3,
                  save_updater: bool = True,
-                 save_on_preemption: bool = False):
+                 save_on_preemption: bool = False,
+                 async_save: bool = False,
+                 queue_depth: int = 2):
         self.dir = directory
         os.makedirs(directory, exist_ok=True)
         self.every_iter = every_n_iterations
@@ -56,10 +162,34 @@ class CheckpointListener(IterationListener):
         self.every_seconds = every_n_seconds
         self.keep_last = int(keep_last)
         self.save_updater = save_updater
+        self.async_save = bool(async_save)
+        self.queue_depth = max(1, int(queue_depth))
         self._last_time = time.monotonic()
         self._model = None
-        self._lock = threading.Lock()
-        self._prev_sigterm = None
+        self._lock = threading.Lock()    # one save() call at a time
+        self._io_lock = threading.Lock()  # serializes zip/meta file IO
+        self._writer_q: Optional["queue.Queue"] = None
+        self._writer_t: Optional[threading.Thread] = None
+        self._writer_stop = threading.Event()
+        self._writer_hb: Optional[_health.Heartbeat] = None
+        self._closed = False
+        reg = _metrics.get_registry()
+        self._m_saves = reg.counter(
+            "checkpoint_saves_total", "checkpoints written", ("reason",))
+        self._m_phase = reg.histogram(
+            "checkpoint_save_seconds",
+            "checkpoint save duration by phase: `snapshot` is the "
+            "fit-thread blocking part (capture + enqueue under "
+            "async_save), `write` the serialize + atomic rename",
+            ("phase",))
+        self._m_coalesced = reg.counter(
+            "checkpoint_coalesced_total",
+            "queued snapshots displaced by a newer one before the "
+            "async writer got to them").labels()
+        self._m_failures = reg.counter(
+            "checkpoint_save_failures_total",
+            "checkpoint writes that raised (save skipped, training "
+            "unaffected)").labels()
         if save_on_preemption:
             self._install_preemption_hook()
 
@@ -80,48 +210,194 @@ class CheckpointListener(IterationListener):
         if self.every_epoch is not None and (epoch + 1) % self.every_epoch == 0:
             self.save(model, reason="epoch")
 
+    def on_fit_end(self, model):
+        # a fit that returns (or raises) leaves no checkpoint still in
+        # flight: the resume contract starts where the fit ended
+        self.flush()
+
     # -- saving ---------------------------------------------------------------
 
     def save(self, model, reason: str = "manual",
              blocking: bool = True) -> Optional[str]:
         """blocking=False (the SIGTERM handler) skips instead of waiting:
-        if a save is already mid-write on this thread, re-entering would
-        corrupt it — and its result is at most one interval stale."""
-        from deeplearning4j_tpu.utils.model_serializer import save_model
+        if a save is already mid-capture on this thread, re-entering
+        would corrupt it — and its result is at most one interval stale.
+        Returns the checkpoint path (under async_save: the path the
+        background writer will publish)."""
+        from deeplearning4j_tpu.utils.model_serializer import ModelSnapshot
 
         if not self._lock.acquire(blocking=blocking):
             logger.warning("checkpoint save already in flight; skipping "
                            "(%s)", reason)
             return None
-        t0 = time.perf_counter()
         try:
-            name = f"checkpoint_iter{model.iteration:09d}.zip"
+            t0 = time.perf_counter()
+            ts_fn = getattr(model, "train_state", None)
+            train_state = ts_fn() if callable(ts_fn) else None
+            with _tracing.span("checkpoint/snapshot", reason=reason):
+                snap = ModelSnapshot.capture(model, self.save_updater,
+                                             train_state=train_state)
+            name = f"checkpoint_iter{snap.iteration:09d}.zip"
             path = os.path.join(self.dir, name)
-            tmp = f"{path}.{os.getpid()}.{reason}.tmp"  # unique per writer
-            with _tracing.span("checkpoint/save", reason=reason):
-                save_model(model, tmp, save_updater=self.save_updater)
+            # preemption writes synchronously even under async_save: the
+            # process is dying, there is no background left to defer to.
+            # Same after close(): its contract is "saves synchronously
+            # afterwards" — re-entering the async path would respawn a
+            # writer thread + heartbeat nothing will ever retire
+            if self.async_save and not self._closed and reason != "preemption":
+                self._ensure_writer()
+                self._enqueue(snap, reason)
+                self._m_phase.labels("snapshot").observe(
+                    time.perf_counter() - t0)
+                self._last_time = time.monotonic()
+                return path
+            self._m_phase.labels("snapshot").observe(
+                time.perf_counter() - t0)
+            out = self._write_snapshot(snap, reason)
+            self._last_time = time.monotonic()
+            return out
+        finally:
+            self._lock.release()
+
+    def _enqueue(self, snap, reason: str):
+        q = self._writer_q
+        while True:
+            try:
+                q.put_nowait((snap, reason))
+                return
+            except queue.Full:
+                # the writer fell behind: displace the OLDEST pending
+                # snapshot (the newest state always wins) and say so
+                try:
+                    q.get_nowait()
+                    q.task_done()
+                    self._m_coalesced.inc()
+                    logger.warning(
+                        "checkpoint writer behind; coalesced an older "
+                        "queued snapshot (%s)", reason)
+                except queue.Empty:
+                    continue
+
+    def _write_snapshot(self, snap, reason: str) -> Optional[str]:
+        """Serialize one captured snapshot to its zip + metadata —
+        shared by the synchronous path and the background writer (which
+        is why the file IO has its own lock: a preemption save must be
+        able to run while the writer owns an older snapshot)."""
+        name = f"checkpoint_iter{snap.iteration:09d}.zip"
+        path = os.path.join(self.dir, name)
+        tmp = f"{path}.{os.getpid()}.{reason}.tmp"  # unique per writer
+        t0 = time.perf_counter()
+        with self._io_lock:
+            with _tracing.span("checkpoint/write", reason=reason):
+                snap.write(tmp)
                 os.replace(tmp, path)  # atomic: never a torn checkpoint
-            reg = _metrics.get_registry()
-            reg.counter("checkpoint_saves_total", "checkpoints written",
-                        ("reason",)).labels(reason).inc()
-            reg.histogram("checkpoint_save_seconds",
-                          "checkpoint save duration (serialize + atomic "
-                          "rename)").observe(time.perf_counter() - t0)
             meta = {
-                "iteration": int(model.iteration),
-                "epoch": int(model.epoch),
+                "iteration": snap.iteration,
+                "epoch": snap.epoch,
                 "ts": time.time(),
                 "reason": reason,
                 "file": name,
             }
-            with open(os.path.join(self.dir, "latest.json"), "w") as f:
-                json.dump(meta, f)
-            self._last_time = time.monotonic()
+            self._write_latest(meta)
             self._gc()
-            logger.info("checkpoint saved: %s (%s)", path, reason)
-            return path
-        finally:
-            self._lock.release()
+        self._m_saves.labels(reason).inc()
+        self._m_phase.labels("write").observe(time.perf_counter() - t0)
+        _blackbox.get_recorder().record_event(
+            "checkpoint_saved", iteration=snap.iteration, reason=reason,
+            file=name)
+        logger.info("checkpoint saved: %s (%s)", path, reason)
+        return path
+
+    def _write_latest(self, meta: dict):
+        """Publish `latest.json` the same way the zip is published: tmp +
+        `os.replace`, so a crash mid-write can never leave torn JSON
+        behind (and restore_latest scans the zips if it somehow does).
+        Monotonic: an async writer finishing an OLDER snapshot after a
+        preemption save must not roll the pointer back."""
+        path = os.path.join(self.dir, _LATEST)
+        try:
+            with open(path) as f:
+                cur = json.load(f)
+            if int(cur.get("iteration", -1)) > int(meta["iteration"]):
+                return
+        except (OSError, ValueError, KeyError, json.JSONDecodeError):
+            pass
+        tmp = f"{path}.{os.getpid()}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(meta, f)
+        os.replace(tmp, path)
+
+    # -- the background writer ------------------------------------------------
+
+    def _ensure_writer(self):
+        if self._writer_t is not None and self._writer_t.is_alive():
+            return
+        self._writer_stop = threading.Event()
+        self._writer_q = queue.Queue(maxsize=self.queue_depth)
+        # heartbeat-registered with the watchdog: a writer wedged inside
+        # a device pull or filesystem stall flips
+        # component_health{component=ckpt_writer} instead of silently
+        # letting checkpoints go stale
+        self._writer_hb = _health.get_health().register(
+            "ckpt_writer", stall_after=300.0)
+        self._writer_t = threading.Thread(
+            target=self._writer_loop,
+            args=(self._writer_q, self._writer_stop, self._writer_hb),
+            daemon=True, name="dl4j-ckpt-writer")
+        self._writer_t.start()
+
+    def _writer_loop(self, q, stop, hb):
+        while True:
+            try:
+                snap, reason = get_abortable(q, stop)
+            except QueueAborted:
+                return
+            try:
+                with hb.busy():
+                    self._write_snapshot(snap, reason)
+            except Exception:
+                # a failed write loses ONE interval, not the run — and
+                # never the writer thread (a dead writer would wedge
+                # every later save)
+                self._m_failures.inc()
+                logger.exception("async checkpoint write failed")
+            finally:
+                q.task_done()
+
+    def flush(self, timeout: float = 120.0):
+        """Wait until every queued snapshot is on disk (no-op for the
+        synchronous mode)."""
+        q = self._writer_q
+        if q is None:
+            return
+        deadline = time.monotonic() + timeout
+        while q.unfinished_tasks and time.monotonic() < deadline:
+            time.sleep(0.02)
+        if q.unfinished_tasks:
+            logger.warning("checkpoint flush timed out with %d pending "
+                           "write(s)", q.unfinished_tasks)
+
+    def close(self):
+        """Flush pending writes and retire the writer thread + signal
+        hook. Idempotent; the listener saves synchronously afterwards."""
+        self._closed = True
+        _sigchain.unregister(self._sig_name())
+        self.flush()
+        self._writer_stop.set()
+        if self._writer_t is not None:
+            self._writer_t.join(timeout=10)
+            self._writer_t = None
+        if self._writer_hb is not None:
+            _health.get_health().unregister(self._writer_hb)
+            self._writer_hb = None
+        self._writer_q = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
 
     def _gc(self):
         # orphaned temp files from writers killed mid-save. A tmp file is
@@ -152,9 +428,7 @@ class CheckpointListener(IterationListener):
                     pass
         if self.keep_last <= 0:
             return
-        ckpts = sorted(
-            f for f in os.listdir(self.dir)
-            if f.startswith("checkpoint_iter") and f.endswith(".zip"))
+        ckpts = [name for _, name in scan_checkpoints(self.dir)]
         for stale in ckpts[:-self.keep_last]:
             try:
                 os.remove(os.path.join(self.dir, stale))
@@ -163,27 +437,24 @@ class CheckpointListener(IterationListener):
 
     # -- preemption -----------------------------------------------------------
 
-    def _install_preemption_hook(self):
-        if threading.current_thread() is not threading.main_thread():
-            logger.warning("preemption hook requires the main thread; "
-                           "skipping signal installation")
-            return
+    def _sig_name(self) -> str:
+        return f"checkpoint-save-{id(self):x}"
 
-        def handler(signum, frame):
+    def _install_preemption_hook(self):
+        def action(signum, frame):
             model = self._model
             if model is not None:
                 try:
                     self.save(model, reason="preemption", blocking=False)
                 except Exception:
                     logger.exception("preemption save failed")
-            if callable(self._prev_sigterm):
-                self._prev_sigterm(signum, frame)
-            else:
-                signal.signal(signal.SIGTERM, signal.SIG_DFL)
-                os.kill(os.getpid(), signal.SIGTERM)
 
-        self._prev_sigterm = signal.getsignal(signal.SIGTERM)
-        signal.signal(signal.SIGTERM, handler)
+        # PRIORITY_SAVE < PRIORITY_DUMP: the model hits disk before the
+        # flight recorder dumps (the dump then even records the
+        # checkpoint_saved event), regardless of which subsystem armed
+        # its hook first — see utils/sigchain
+        _sigchain.register(self._sig_name(), action,
+                           priority=_sigchain.PRIORITY_SAVE)
 
     # -- resume ---------------------------------------------------------------
 
@@ -191,18 +462,17 @@ class CheckpointListener(IterationListener):
     def restore_latest(directory: str,
                        load_updater: bool = True) -> Tuple[object, dict]:
         """(model, meta) from the newest checkpoint in `directory`.
-        Raises FileNotFoundError when none exists (fresh start)."""
+        Raises FileNotFoundError when none exists (fresh start). Survives
+        torn/missing `latest.json` by scanning the checkpoint zips."""
         from deeplearning4j_tpu.utils.model_serializer import load_model
 
-        meta_path = os.path.join(directory, "latest.json")
-        if not os.path.exists(meta_path):
+        found = latest_checkpoint(directory)
+        if found is None:
             raise FileNotFoundError(f"no checkpoint in {directory!r}")
-        with open(meta_path) as f:
-            meta = json.load(f)
+        path, meta = found
         t0 = time.perf_counter()
         with _tracing.span("checkpoint/load", file=meta.get("file")):
-            model = load_model(os.path.join(directory, meta["file"]),
-                               load_updater=load_updater)
+            model = load_model(path, load_updater=load_updater)
         _metrics.get_registry().histogram(
             "checkpoint_load_seconds",
             "checkpoint restore duration").observe(time.perf_counter() - t0)

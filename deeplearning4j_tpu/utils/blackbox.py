@@ -291,22 +291,22 @@ def install_crash_hooks(path: str, recorder: Optional[FlightRecorder] = None,
         rec.record_event("signal", signum=int(signum))
         rec._crash_dumped = True
         rec.dump(reason=f"signal {signum}")
-        # die with SIGTERM semantics so parents/timeouts see the real
-        # cause, not a clean exit
-        signal.signal(signal.SIGTERM, signal.SIG_DFL)
-        os.kill(os.getpid(), signal.SIGTERM)
 
-    # ORDER MATTERS: the Python-level handler must be installed BEFORE
-    # faulthandler.register(chain=True) — last sigaction wins, so the
-    # reverse order would displace faulthandler's async-signal-safe
+    # The dump rides the shared SIGTERM chain (utils/sigchain) at
+    # PRIORITY_DUMP: a checkpoint listener's preemption save (PRIORITY_
+    # SAVE) always runs first and the chain's tail restores die-with-
+    # SIGTERM semantics — installation order between the two subsystems
+    # no longer decides anything. The chain handler must be installed
+    # BEFORE faulthandler.register(chain=True) — last sigaction wins, so
+    # the reverse order would displace faulthandler's async-signal-safe
     # C-level dump (the only layer that still fires when the interpreter
     # is wedged inside native code). This way SIGTERM first writes the
     # native stacks.txt, then chains into the JSON dump when the main
     # thread reaches a bytecode boundary.
-    try:
-        signal.signal(signal.SIGTERM, _on_sigterm)
-    except ValueError:  # not the main thread: signal layer unavailable
-        logger.warning("SIGTERM hook needs the main thread; skipped")
+    from deeplearning4j_tpu.utils import sigchain
+
+    sigchain.register("blackbox-dump", _on_sigterm,
+                      priority=sigchain.PRIORITY_DUMP)
 
     try:
         _fault_file = open(path + ".stacks.txt", "w")

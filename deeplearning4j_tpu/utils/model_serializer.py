@@ -36,6 +36,7 @@ _LAYER_STATE = "layerState.bin"
 _UPDATER_STATE_NPZ = "updaterState.npz"
 _LAYER_STATE_NPZ = "layerState.npz"
 _META = "meta.json"
+_TRAIN_STATE = "trainState.json"
 
 
 def _tree_to_npz_bytes(tree) -> bytes:
@@ -106,25 +107,72 @@ def _unflatten_tree(template, vec: np.ndarray):
     return jax.tree_util.tree_unflatten(treedef, out)
 
 
-def save_model(net, path: Union[str, os.PathLike], save_updater: bool = True) -> None:
-    """Write a model zip (reference: ModelSerializer.writeModel :79-118)."""
-    net._require_init()
-    coeffs = np.asarray(net.params())
-    meta = {
-        "format_version": FORMAT_VERSION,
-        "network_type": type(net).__name__,
-        "iteration": int(net.iteration),
-        "epoch": int(net.epoch),
-        "save_updater": bool(save_updater),
-        "coefficients_dtype": coeffs.dtype.str,  # e.g. "<f4", "<f8"
-    }
-    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
-        zf.writestr(_CONFIG_JSON, net.conf.to_json())
-        zf.writestr(_META, json.dumps(meta, indent=2))
-        zf.writestr(_COEFFICIENTS, coeffs.astype(coeffs.dtype.newbyteorder("<")).tobytes())
-        zf.writestr(_LAYER_STATE_NPZ, _tree_to_npz_bytes(net.state_list))
-        if save_updater:
-            zf.writestr(_UPDATER_STATE_NPZ, _tree_to_npz_bytes(net.upd_state))
+class ModelSnapshot:
+    """Point-in-time capture of everything a model zip holds, split so
+    async checkpointing can separate the two costs: `capture()` grabs
+    REFERENCES (jax arrays are immutable and the train step replaces —
+    never mutates — the params/state/updater pytrees, so holding the old
+    trees IS a consistent snapshot; cost: outer-list copies and ints),
+    while `write()` does the device→host pulls, flattening, compression
+    and zip IO. The checkpoint listener runs capture() on the fit thread
+    (the blocking "snapshot" phase) and write() on its background writer
+    (the "write" phase); the synchronous save path runs both back to
+    back — same bytes either way."""
+
+    __slots__ = ("conf_json", "network_type", "iteration", "epoch",
+                 "save_updater", "layer_confs", "params_list",
+                 "state_list", "upd_state", "train_state")
+
+    @classmethod
+    def capture(cls, net, save_updater: bool = True,
+                train_state: Optional[dict] = None) -> "ModelSnapshot":
+        net._require_init()
+        snap = cls()
+        snap.conf_json = net.conf.to_json()
+        snap.network_type = type(net).__name__
+        snap.iteration = int(net.iteration)
+        snap.epoch = int(net.epoch)
+        snap.save_updater = bool(save_updater)
+        snap.layer_confs = list(net._ordered_layer_confs())
+        snap.params_list = list(net.params_list)
+        snap.state_list = list(net.state_list)
+        snap.upd_state = net.upd_state if save_updater else None
+        snap.train_state = train_state
+        return snap
+
+    def write(self, path: Union[str, os.PathLike]) -> None:
+        from deeplearning4j_tpu.nn.params import params_to_flat
+
+        coeffs = np.asarray(params_to_flat(self.layer_confs,
+                                           self.params_list))
+        meta = {
+            "format_version": FORMAT_VERSION,
+            "network_type": self.network_type,
+            "iteration": self.iteration,
+            "epoch": self.epoch,
+            "save_updater": self.save_updater,
+            "coefficients_dtype": coeffs.dtype.str,  # e.g. "<f4", "<f8"
+        }
+        with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
+            zf.writestr(_CONFIG_JSON, self.conf_json)
+            zf.writestr(_META, json.dumps(meta, indent=2))
+            zf.writestr(
+                _COEFFICIENTS,
+                coeffs.astype(coeffs.dtype.newbyteorder("<")).tobytes())
+            zf.writestr(_LAYER_STATE_NPZ, _tree_to_npz_bytes(self.state_list))
+            if self.save_updater:
+                zf.writestr(_UPDATER_STATE_NPZ,
+                            _tree_to_npz_bytes(self.upd_state))
+            if self.train_state is not None:
+                zf.writestr(_TRAIN_STATE, json.dumps(self.train_state))
+
+
+def save_model(net, path: Union[str, os.PathLike], save_updater: bool = True,
+               train_state: Optional[dict] = None) -> None:
+    """Write a model zip (reference: ModelSerializer.writeModel :79-118).
+    `train_state` (a JSON-safe dict, see NetworkBase.train_state()) rides
+    along for mid-epoch resume."""
+    ModelSnapshot.capture(net, save_updater, train_state).write(path)
 
 
 def _read_vec(zf: zipfile.ZipFile, name: str, dtype: str = "<f4") -> Optional[np.ndarray]:
@@ -193,6 +241,68 @@ def load_model(path: Union[str, os.PathLike], load_updater: bool = True):
     net.iteration = int(meta.get("iteration", 0))
     net.epoch = int(meta.get("epoch", 0))
     return net
+
+
+def read_train_state(path: Union[str, os.PathLike]) -> Optional[dict]:
+    """The TrainState dict a checkpoint carries (None for checkpoints
+    written without one — plain save_model calls, pre-resume files)."""
+    with zipfile.ZipFile(path, "r") as zf:
+        try:
+            return json.loads(zf.read(_TRAIN_STATE).decode("utf-8"))
+        except KeyError:
+            return None
+
+
+def restore_fit_state(net, path: Union[str, os.PathLike],
+                      load_updater: bool = True) -> dict:
+    """Load a checkpoint zip INTO an existing (already-configured) net:
+    params, layer state, updater state, iteration/epoch counters.
+    Returns the zip's meta dict with the saved TrainState (or None)
+    under "train_state" — the `fit(resume_from=...)` restore path, which
+    continues an existing object instead of constructing a new network
+    the way load_model does.
+
+    The checkpoint's configuration must match the net's (compared as
+    parsed JSON, so formatting drift is ignored): silently resuming a
+    different architecture would train a wrong model."""
+    net._require_init()
+    with zipfile.ZipFile(path, "r") as zf:
+        meta = json.loads(zf.read(_META).decode("utf-8"))
+        saved_conf = json.loads(zf.read(_CONFIG_JSON).decode("utf-8"))
+        if saved_conf != json.loads(net.conf.to_json()):
+            raise ValueError(
+                f"checkpoint {path} was written from a different "
+                f"configuration than this {type(net).__name__} — resume "
+                "into the matching model, or use load_model() to "
+                "reconstruct the saved one")
+        coeffs = _read_vec(
+            zf, _COEFFICIENTS, meta.get("coefficients_dtype", "<f4"))
+        layer_state = _read_state(zf, _LAYER_STATE_NPZ, _LAYER_STATE)
+        upd = (_read_state(zf, _UPDATER_STATE_NPZ, _UPDATER_STATE)
+               if load_updater else None)
+        try:
+            train_state = json.loads(zf.read(_TRAIN_STATE).decode("utf-8"))
+        except KeyError:
+            train_state = None
+
+    def restore(template, entry):
+        kind, payload = entry
+        if kind == "npz":
+            return _tree_from_npz_bytes(template, payload)
+        return _unflatten_tree(template, payload)
+
+    if coeffs is not None:
+        net.set_params(coeffs)
+    if layer_state is not None and not (
+        layer_state[0] == "vec" and layer_state[1].size == 0
+    ):
+        net.state_list = restore(net.state_list, layer_state)
+    if upd is not None and meta.get("save_updater", True):
+        net.upd_state = restore(net.upd_state, upd)
+    net.iteration = int(meta.get("iteration", 0))
+    net.epoch = int(meta.get("epoch", 0))
+    meta["train_state"] = train_state
+    return meta
 
 
 def restore_multi_layer_network(path, load_updater: bool = True):

@@ -1,0 +1,104 @@
+"""Deterministic SIGTERM action chain.
+
+Two subsystems want the TPU preemption signal: the checkpoint listener
+(save the model before the VM disappears) and the flight recorder (dump
+the black box). Each used to install its own `signal.signal` handler and
+chain to whatever was there before — so INSTALLATION ORDER decided
+whether the preemption save ran before the crash dump, and a listener
+installed after the crash hooks silently demoted the dump to "whenever
+the previous handler got around to it".
+
+This module owns the one SIGTERM handler instead. Subsystems register
+named actions with a priority; on SIGTERM every action runs in priority
+order (checkpoint save = PRIORITY_SAVE, black-box dump = PRIORITY_DUMP,
+so the save always precedes the dump regardless of who armed first),
+then the pre-chain handler (or the default die-with-SIGTERM) runs last.
+A raising action is logged and skipped — one broken hook must not eat
+the preemption window of the others.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import threading
+from typing import Callable, List, Optional, Tuple
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+# canonical priorities: state first (it needs the grace window most),
+# forensics second, whatever was installed before the chain last
+PRIORITY_SAVE = 10
+PRIORITY_DUMP = 20
+
+_lock = threading.Lock()
+_actions: List[Tuple[int, str, Callable]] = []
+_prev_handler = None
+_installed = False
+
+
+def register(name: str, fn: Callable[[int, object], None],
+             priority: int = 50) -> None:
+    """Add (or replace, by name) a SIGTERM action. `fn(signum, frame)`
+    runs inside the signal handler on the main thread — it must not
+    block indefinitely. Lower priority runs earlier. Installs the chain
+    handler on first registration (main thread only)."""
+    with _lock:
+        _actions[:] = [a for a in _actions if a[1] != name]
+        _actions.append((priority, name, fn))
+        _actions.sort(key=lambda a: (a[0], a[1]))
+    install()
+
+
+def unregister(name: str) -> None:
+    with _lock:
+        _actions[:] = [a for a in _actions if a[1] != name]
+
+
+def actions() -> List[Tuple[int, str, Callable]]:
+    with _lock:
+        return list(_actions)
+
+
+def _handler(signum, frame):
+    for _, name, fn in actions():
+        try:
+            fn(signum, frame)
+        except Exception:
+            logger.exception("SIGTERM action %r failed", name)
+    prev = _prev_handler
+    if callable(prev):
+        prev(signum, frame)
+    elif prev != signal.SIG_IGN:
+        # die with SIGTERM semantics so parents/timeouts see the real
+        # cause, not a clean exit
+        signal.signal(signal.SIGTERM, signal.SIG_DFL)
+        os.kill(os.getpid(), signal.SIGTERM)
+
+
+def install() -> bool:
+    """Install the chain handler (idempotent). Re-installs when someone
+    else replaced the handler since (tests save/restore handlers around
+    themselves; the chain must survive that). Returns True when the
+    chain handler is the live SIGTERM handler after the call."""
+    global _prev_handler, _installed
+    if threading.current_thread() is not threading.main_thread():
+        logger.warning("SIGTERM chain requires the main thread; "
+                       "skipping signal installation")
+        return False
+    current = signal.getsignal(signal.SIGTERM)
+    if current is _handler:
+        return True
+    try:
+        signal.signal(signal.SIGTERM, _handler)
+    except ValueError:
+        logger.warning("SIGTERM chain installation failed", exc_info=True)
+        return False
+    _prev_handler = current
+    _installed = True
+    return True
+
+
+def installed() -> bool:
+    return signal.getsignal(signal.SIGTERM) is _handler

@@ -70,5 +70,9 @@ fi
 # surface the conftest thread-leak guard's session verdict (each leak also
 # failed its test above — this is the at-a-glance summary)
 grep -a '^T1 THREAD GUARD:' /tmp/_t1.log || echo "T1 THREAD GUARD: no verdict line (session died early?)"
+# checkpoint tmp-orphan guard: a *.tmp file surviving the session is a
+# save that died between write and atomic rename (conftest scans the
+# run's tmp dirs — same spirit as the thread-leak guard)
+grep -a '^T1 CKPT TMP GUARD:' /tmp/_t1.log || echo "T1 CKPT TMP GUARD: no verdict line (session died early?)"
 echo "T1 OK: $(wc -l < "$artifact" | tr -d ' ') failing (all within the $(wc -l < "$baseline" | tr -d ' ')-name baseline); artifact: $artifact"
 exit 0

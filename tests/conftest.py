@@ -109,6 +109,34 @@ def pytest_sessionfinish(session, exitstatus):
     else:
         print("\nT1 THREAD GUARD: ok (no leaked pipeline worker threads)")
 
+    # Checkpoint tmp-orphan guard (scripts/t1.sh greps the verdict):
+    # every checkpoint/snapshot/latest.json write goes tmp + os.replace,
+    # so a `*.tmp` file still in the session's tmp dirs after the run is
+    # a writer that died (or was never joined) mid-save — exactly the
+    # torn-file class the atomic-rename discipline exists to prevent.
+    # (CheckpointListener._gc sweeps stale orphans at runtime; the guard
+    # catches tests that leave them behind without ever GC-ing.)
+    try:
+        basetemp = session.config._tmp_path_factory.getbasetemp()
+    except Exception:
+        basetemp = None
+    orphans = []
+    if basetemp is not None:
+        try:
+            orphans = sorted(
+                str(p) for p in basetemp.rglob("*.tmp*")
+                if "checkpoint_iter" in p.name
+                or p.name.startswith(("latest.json.", "tables.npz.")))
+        except OSError:
+            pass
+    if orphans:
+        print(f"T1 CKPT TMP GUARD: {len(orphans)} orphaned checkpoint "
+              "tmp file(s) left by the run:")
+        for p in orphans:
+            print(f"T1 CKPT TMP GUARD:   {p}")
+    else:
+        print("T1 CKPT TMP GUARD: ok (no orphaned checkpoint tmp files)")
+
     # Opt-in observability artifact (scripts/t1.sh T1_METRICS_DUMP=1):
     # dump the process-global metrics registry after the run so compile
     # counts / helper events can be diffed across PRs.

@@ -1,0 +1,134 @@
+"""Subprocess half of tests/test_fault_tolerance.py.
+
+Runs a small deterministic fit with checkpointing armed and prints one
+flushed "STEP <iteration> <score>" line per training step, so the parent
+test can kill the process (SIGKILL for the preemption-recovery tests,
+SIGTERM for the signal-chain ordering tests) at a step of its choosing.
+The network/data builders live here — the parent imports them too, so
+the killed run, the resumed run, and the uninterrupted reference run are
+the same model on the same batches by construction.
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_default_matmul_precision", "highest")
+
+import numpy as np  # noqa: E402
+
+from deeplearning4j_tpu.data.dataset import DataSet  # noqa: E402
+from deeplearning4j_tpu.data.iterators import ListDataSetIterator  # noqa: E402
+from deeplearning4j_tpu.train.checkpoint import CheckpointListener  # noqa: E402
+from deeplearning4j_tpu.train.listeners import IterationListener  # noqa: E402
+
+N_EXAMPLES = 48
+BATCH = 8
+N_FEATURES = 5
+N_CLASSES = 3
+SHUFFLE_SEED = 11
+
+
+def build_net(seed: int = 7):
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater("adam")
+            .learning_rate(0.02).list()
+            .layer(DenseLayer(n_out=12, activation="tanh"))
+            .layer(OutputLayer(n_out=N_CLASSES, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.feed_forward(N_FEATURES)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def build_iterator(seed: int = 7):
+    """Shuffling iterator: each epoch deals a DIFFERENT (epoch-seeded)
+    permutation, so mid-epoch resume only reproduces the reference run if
+    the iterator's epoch state is actually restored — a non-shuffling
+    iterator would hide that bug."""
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((N_EXAMPLES, N_FEATURES)).astype(np.float32)
+    y = np.zeros((N_EXAMPLES, N_CLASSES), np.float32)
+    y[np.arange(N_EXAMPLES), rng.integers(0, N_CLASSES, N_EXAMPLES)] = 1.0
+    return ListDataSetIterator(DataSet(x, y), BATCH, shuffle=True,
+                               seed=SHUFFLE_SEED)
+
+
+class StepPrinter(IterationListener):
+    """One flushed line per step — the parent's kill trigger. The small
+    sleep widens the window between steps so the parent's signal lands at
+    (about) the step it chose instead of after the fit finished."""
+
+    def __init__(self, sleep: float = 0.05):
+        self.sleep = sleep
+
+    def iteration_done(self, model, iteration, info):
+        # .17g round-trips a float64 exactly: the parent compares these
+        # against in-process reference scores with ==
+        print(f"STEP {iteration} {float(info['score']()):.17g}", flush=True)
+        if self.sleep:
+            time.sleep(self.sleep)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mode", choices=["fit", "sigterm"], default="fit")
+    ap.add_argument("--ckpt-dir", required=True)
+    ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument("--resume", action="store_true",
+                    help="pass resume_from=<ckpt-dir> to fit (chaos loop)")
+    ap.add_argument("--async-save", action="store_true")
+    ap.add_argument("--sleep", type=float, default=0.05)
+    ap.add_argument("--order", choices=["ckpt-first", "hooks-first"],
+                    default="ckpt-first",
+                    help="sigterm mode: which subsystem arms SIGTERM first")
+    ap.add_argument("--dump", default=None,
+                    help="sigterm mode: blackbox crash-dump path")
+    args = ap.parse_args()
+
+    def make_listener():
+        # sigterm mode: NO periodic schedule — the only checkpoint that
+        # can exist is the one the preemption hook saved, so its presence
+        # proves the SIGTERM chain ran the save action
+        sig = args.mode == "sigterm"
+        return CheckpointListener(
+            args.ckpt_dir,
+            every_n_iterations=(None if sig else 1),
+            every_n_epochs=(None if sig else 1),
+            keep_last=3,
+            save_on_preemption=sig,
+            async_save=args.async_save)
+
+    if args.mode == "sigterm":
+        # the regression under test: installation ORDER between the
+        # checkpoint preemption hook and the blackbox crash hooks must
+        # not change the outcome (save first, then dump, then die)
+        from deeplearning4j_tpu.utils.blackbox import install_crash_hooks
+
+        if args.order == "hooks-first":
+            install_crash_hooks(args.dump)
+            listener = make_listener()
+        else:
+            listener = make_listener()
+            install_crash_hooks(args.dump)
+    else:
+        listener = make_listener()
+
+    net = build_net()
+    net.set_listeners(listener, StepPrinter(args.sleep))
+    net.fit(build_iterator(), epochs=args.epochs,
+            resume_from=(args.ckpt_dir if args.resume else None))
+    print("FIT DONE", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -632,13 +632,17 @@ def test_cli_metrics_watch_prints_deltas(capsys):
     g.set(0)
 
     def mutate():
-        time.sleep(0.08)
+        # the delay must land strictly between the watch loop's baseline
+        # snapshot (taken ~instantly) and its first tick (at ~1.0s): a
+        # 0.35s/1.0s split keeps both margins wide enough that a loaded
+        # 2-core CI box can't reorder them (0.08s/0.25s flaked there)
+        time.sleep(0.35)
         c.inc(3)
         g.set(7)
 
     t = threading.Thread(target=mutate, daemon=True, name="dl4j-bb-watch")
     t.start()
-    rc = cli_main(["metrics", "--watch", "0.25", "--watch-count", "2"])
+    rc = cli_main(["metrics", "--watch", "1.0", "--watch-count", "2"])
     t.join(5)
     assert rc == 0
     out = capsys.readouterr().out

@@ -553,7 +553,10 @@ def test_checkpoint_save_metrics(tmp_path):
     assert lst.save(net, reason="manual") is not None
     assert reg.get("checkpoint_saves_total").labels(
         reason="manual").value == before + 1
-    assert reg.get("checkpoint_save_seconds").labels().count >= 1
+    # the save histogram is phase-split: `snapshot` (fit-thread blocking
+    # capture) and `write` (serialize + atomic rename)
+    assert reg.get("checkpoint_save_seconds").labels("snapshot").count >= 1
+    assert reg.get("checkpoint_save_seconds").labels("write").count >= 1
 
 
 def test_paramserver_rpc_metrics():

@@ -92,10 +92,14 @@ def test_dead_endpoint_drops_push_and_counts_it():
     server = EmbeddingParameterServer({"syn0": np.zeros((4, 3), np.float32)})
     port = server.start()
     try:
-        # two "shards": the second URL is a closed port
+        # two "shards": the second URL is a closed port. replay_capacity=0
+        # disables the failover replay buffer — this test pins the
+        # degrade-by-dropping path (test_paramserver_failover.py covers
+        # park-and-replay)
         client = EmbeddingPSClient(
             [f"http://127.0.0.1:{port}", "http://127.0.0.1:1"],
-            timeout=2.0)
+            timeout=2.0, max_retries=1, retry_backoff=0.01,
+            replay_capacity=0)
         rows = np.array([1, 3])  # odd rows -> owner 1 (the dead one)
         client.push_async("syn0", rows, np.ones((2, 3), np.float32))
         client.flush()

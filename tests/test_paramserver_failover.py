@@ -1,0 +1,228 @@
+"""Paramserver failover (ISSUE 7): server-side write-ahead journaling +
+snapshot/restore, and the client's retry-with-backoff + park-and-replay
+buffer — a restarted shard owner converges instead of silently dropping
+async gradient mass (and whatever IS lost stays counted)."""
+
+import os
+import struct
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.parallel.paramserver import (
+    EmbeddingParameterServer,
+    EmbeddingPSClient,
+    _pack_request,
+)
+
+
+def _wait_until(pred, timeout=10.0, every=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(every)
+    return pred()
+
+
+# -- server-side durability ---------------------------------------------------
+
+
+def test_journal_replays_after_crash(tmp_path):
+    """Kill a journal-armed server without snapshotting; a new server on
+    the same directory replays every journaled push."""
+    jdir = str(tmp_path / "j")
+    t0 = np.zeros((8, 3), np.float32)
+    server = EmbeddingParameterServer({"syn0": t0.copy()}, journal_dir=jdir)
+    port = server.start()
+    try:
+        client = EmbeddingPSClient([f"http://127.0.0.1:{port}"])
+        rows = np.array([0, 2, 5])
+        client.push_async("syn0", rows, np.ones((3, 3), np.float32))
+        client.push_async("syn0", rows, np.ones((3, 3), np.float32))
+        client.flush()
+        _wait_until(lambda: server.pushes_applied == 2)
+        expect = server.tables["syn0"].copy()
+        client.close()
+    finally:
+        server.stop()  # "crash": no snapshot() — only the journal survives
+
+    reborn = EmbeddingParameterServer({"syn0": t0.copy()}, journal_dir=jdir)
+    try:
+        np.testing.assert_array_equal(reborn.tables["syn0"], expect)
+        assert reborn.tables["syn0"][0, 0] == 2.0
+    finally:
+        reborn.stop()
+
+
+def test_snapshot_truncates_journal_and_restores(tmp_path):
+    jdir = str(tmp_path / "s")
+    server = EmbeddingParameterServer(
+        {"syn0": np.zeros((4, 2), np.float32)}, journal_dir=jdir)
+    server.push("syn0", [1], np.full((1, 2), 3.0, np.float32))
+    path = server.snapshot()
+    assert os.path.exists(path)
+    assert os.path.getsize(os.path.join(jdir, "journal.bin")) == 0
+    # post-snapshot pushes land in the fresh journal
+    server.push("syn0", [2], np.full((1, 2), 5.0, np.float32))
+    expect = server.tables["syn0"].copy()
+    server.stop()
+
+    reborn = EmbeddingParameterServer(
+        {"syn0": np.zeros((4, 2), np.float32)}, journal_dir=jdir)
+    try:
+        np.testing.assert_array_equal(reborn.tables["syn0"], expect)
+    finally:
+        reborn.stop()
+
+
+def test_torn_journal_tail_discarded(tmp_path):
+    """A writer SIGKILLed mid-append leaves a half-record; restore must
+    replay everything before it and drop only the tail."""
+    jdir = str(tmp_path / "torn")
+    server = EmbeddingParameterServer(
+        {"syn0": np.zeros((4, 2), np.float32)}, journal_dir=jdir)
+    server.push("syn0", [0], np.ones((1, 2), np.float32))
+    server.push("syn0", [1], np.ones((1, 2), np.float32))
+    expect = server.tables["syn0"].copy()
+    server.stop()
+    # a torn record: full length prefix, truncated payload
+    payload = _pack_request("syn0", np.array([3], np.int64),
+                            np.ones((1, 2), np.float32))
+    with open(os.path.join(jdir, "journal.bin"), "ab") as f:
+        f.write(struct.pack("<I", len(payload)) + payload[: len(payload) // 2])
+    reborn = EmbeddingParameterServer(
+        {"syn0": np.zeros((4, 2), np.float32)}, journal_dir=jdir)
+    try:
+        np.testing.assert_array_equal(reborn.tables["syn0"], expect)
+        assert reborn.tables["syn0"][3, 0] == 0.0  # torn push NOT applied
+    finally:
+        reborn.stop()
+
+
+def test_snapshot_every_auto_truncates(tmp_path):
+    jdir = str(tmp_path / "auto")
+    server = EmbeddingParameterServer(
+        {"syn0": np.zeros((4, 2), np.float32)}, journal_dir=jdir,
+        snapshot_every=3)
+    for i in range(7):
+        server.push("syn0", [i % 4], np.ones((1, 2), np.float32))
+    try:
+        # 7 pushes, snapshot every 3 -> 2 snapshots; 1 push left journaled
+        assert os.path.exists(os.path.join(jdir, "tables.npz"))
+        with open(os.path.join(jdir, "journal.bin"), "rb") as f:
+            buf = f.read()
+        (rec_len,) = struct.unpack_from("<I", buf, 0)
+        assert len(buf) == 4 + rec_len  # exactly one record
+    finally:
+        server.stop()
+
+
+def test_snapshot_shape_mismatch_rejected(tmp_path):
+    jdir = str(tmp_path / "shape")
+    server = EmbeddingParameterServer(
+        {"syn0": np.zeros((4, 2), np.float32)}, journal_dir=jdir)
+    server.push("syn0", [0], np.ones((1, 2), np.float32))
+    server.snapshot()
+    server.stop()
+    with pytest.raises(ValueError, match="shape"):
+        EmbeddingParameterServer({"syn0": np.zeros((9, 9), np.float32)},
+                                 journal_dir=jdir)
+
+
+# -- client failover ----------------------------------------------------------
+
+
+def test_client_parks_and_replays_when_endpoint_returns(tmp_path):
+    """The convergence contract: pushes against a down endpoint PARK
+    (not drop), and the drain's idle tick replays them once the endpoint
+    comes back — a restarted journal-backed server ends up with every
+    batch."""
+    jdir = str(tmp_path / "replay")
+    t0 = np.zeros((6, 2), np.float32)
+    server = EmbeddingParameterServer({"syn0": t0.copy()}, journal_dir=jdir)
+    port = server.start()
+    url = f"http://127.0.0.1:{port}"
+    client = EmbeddingPSClient([url], timeout=2.0, max_retries=1,
+                               retry_backoff=0.01, replay_capacity=16)
+    try:
+        rows = np.array([1, 4])
+        client.push_async("syn0", rows, np.ones((2, 2), np.float32))
+        client.flush()
+        _wait_until(lambda: server.pushes_applied == 1)
+        server.stop()  # the outage
+
+        for _ in range(3):
+            client.push_async("syn0", rows, np.ones((2, 2), np.float32))
+        client.flush()
+        assert _wait_until(lambda: client.pending_pushes() == 3)
+        assert client.dropped_pushes == 0  # parked, not lost
+
+        # the shard owner comes back on the SAME port, journal intact
+        reborn = EmbeddingParameterServer({"syn0": t0.copy()},
+                                          journal_dir=jdir, port=port)
+        reborn.start()
+        try:
+            # no new traffic needed: the idle tick replays the backlog
+            assert _wait_until(lambda: client.pending_pushes() == 0, 15.0)
+            _wait_until(lambda: reborn.pushes_applied >= 3)
+            np.testing.assert_array_equal(
+                reborn.tables["syn0"][1], np.full(2, 4.0, np.float32))
+            assert client.dropped_pushes == 0
+        finally:
+            expect_done = reborn
+            client.close()
+            expect_done.stop()
+    except BaseException:
+        client.close()
+        raise
+
+
+def test_replay_overflow_drops_oldest_and_counts(tmp_path):
+    """Only replay-buffer OVERFLOW loses pushes, and every loss is
+    counted — degradation observable, never silent."""
+    client = EmbeddingPSClient(["http://127.0.0.1:1"], timeout=0.5,
+                               max_retries=0, retry_backoff=0.01,
+                               replay_capacity=2)
+    try:
+        rows = np.array([0])
+        for _ in range(5):
+            client.push_async("syn0", rows, np.ones((1, 3), np.float32))
+        client.flush()
+        assert _wait_until(lambda: client.dropped_pushes >= 3)
+        assert client.pending_pushes() <= 2
+    finally:
+        client.close()
+    # close() against a still-dead endpoint accounts the parked remainder
+    assert client.pending_pushes() == 0
+    assert client.dropped_pushes == 5
+
+
+def test_pull_retries_through_a_blip(tmp_path):
+    """A pull against a server that comes up within the retry window
+    succeeds instead of surfacing the transient fault."""
+    t0 = np.arange(12, dtype=np.float32).reshape(6, 2)
+    server = EmbeddingParameterServer({"syn0": t0.copy()})
+    port = server.start()
+    server.stop()  # learn a port, then take the server down
+
+    client = EmbeddingPSClient([f"http://127.0.0.1:{port}"], timeout=2.0,
+                               max_retries=8, retry_backoff=0.2)
+    reborn = EmbeddingParameterServer({"syn0": t0.copy()}, port=port)
+    import threading
+
+    def bring_back():
+        time.sleep(0.4)
+        reborn.start()
+
+    t = threading.Thread(target=bring_back, daemon=True,
+                         name="dl4j-test-bringback")
+    t.start()
+    try:
+        got = client.pull("syn0", np.array([2, 5]))
+        np.testing.assert_array_equal(got, t0[[2, 5]])
+    finally:
+        t.join()
+        client.close()
+        reborn.stop()
