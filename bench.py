@@ -549,6 +549,168 @@ def bench_parallel_inference(max_batch=64, n_requests=512, clients=16,
     }
 
 
+def bench_parallel_inference_overload(duration=3.0, n_in=64, hidden=64,
+                                      classes=8, max_batch=4,
+                                      queue_capacity=None, slo_ms=100.0):
+    """Graceful degradation under sustained ~2x overload — the numbers
+    the admission-control/load-shedding tier is graded on, recorded next
+    to the throughput benches instead of only living in a slow test.
+    Phase 1 saturates the pipeline with few enough closed-loop clients
+    that nothing sheds (the measured capacity); phase 2 keeps ~2x the
+    pipeline+queue's absorbable outstanding work in flight, so admission
+    MUST shed the excess. Reported: shed rate, p99 latency of ADMITTED
+    requests vs the SLO (overload must turn into fast 429s, not
+    universal lateness), max queue depth vs capacity (boundedness), and
+    the conservation law admitted == completed + shed + failed."""
+    import threading
+
+    from deeplearning4j_tpu.nn.conf import (
+        DenseLayer,
+        NeuralNetConfiguration,
+        OutputLayer,
+        Updater,
+    )
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.parallel import (
+        ParallelInference,
+        data_parallel_mesh,
+    )
+    from deeplearning4j_tpu.parallel.inference import (
+        DeadlineExceeded,
+        RequestRejected,
+    )
+    from deeplearning4j_tpu.utils import health as _health
+    from deeplearning4j_tpu.utils.latency import LatencyTracker
+
+    on_tpu = jax.default_backend() not in ("cpu",)
+    # queue_capacity=None → per-backend preset: a small CPU box needs a
+    # shorter queue (and net) or GIL contention between the closed-loop
+    # clients starves the dispatcher into shedding EVERYTHING, measuring
+    # contention instead of admission control; an explicit value wins
+    if queue_capacity is None:
+        queue_capacity = 8 if on_tpu else 4
+    if not on_tpu:
+        hidden = 48
+    # "2x overload" means outstanding work, not offered rate (closed-loop
+    # clients self-throttle): the pipeline absorbs ~2 groups in flight
+    # plus the queue, so 2x that many 1-row closed-loop clients keeps
+    # admission permanently oversubscribed — the client count is DERIVED
+    # from that, not a knob
+    absorbable = 2 * max_batch + queue_capacity
+    clients = 2 * absorbable
+    conf = (
+        NeuralNetConfiguration.builder().seed(7).updater(Updater.SGD)
+        .learning_rate(0.05).weight_init("xavier")
+        .precision("bf16" if on_tpu else "f32").list()
+        .layer(DenseLayer(n_in=n_in, n_out=hidden, activation="relu"))
+        .layer(OutputLayer(n_in=hidden, n_out=classes,
+                           activation="softmax", loss="mcxent"))
+        .build()
+    )
+    net = MultiLayerNetwork(conf).init()
+    pi = ParallelInference(net, data_parallel_mesh(),
+                           max_batch_size=max_batch, batch_timeout_ms=1.0,
+                           queue_capacity=queue_capacity,
+                           handoff_capacity=1, default_deadline_ms=slo_ms,
+                           component_prefix="bench_overload")
+    pi.warmup((n_in,))
+    rng = np.random.default_rng(0)
+    reqs = [rng.standard_normal((1, n_in)).astype(np.float32)
+            for _ in range(64)]
+    lat = LatencyTracker(window=100_000)
+    stop = threading.Event()
+    max_depth = [0]
+    client_errors = []
+
+    def client(i, track):
+        j = 0
+        try:
+            while not stop.is_set():
+                j += 1
+                t0 = time.perf_counter()
+                try:
+                    pi.output(reqs[(i * 31 + j) % len(reqs)])
+                    if track:
+                        lat.record(time.perf_counter() - t0)
+                except (DeadlineExceeded, RequestRejected) as e:
+                    # shed totals come from the metrics deltas; honor the
+                    # server's Retry-After hint (bounded: a bench client
+                    # must keep offering load)
+                    stop.wait(min(getattr(e, "retry_after", 0.0), 0.005))
+        except BaseException as e:  # noqa: BLE001 - reported, fails run
+            client_errors.append(f"{type(e).__name__}: {e}")
+
+    def run_phase(n_clients, seconds, track):
+        stop.clear()
+        threads = [threading.Thread(target=client, args=(i, track),
+                                    daemon=True,
+                                    name=f"dl4j-bench-ovl-{i}")
+                   for i in range(n_clients)]
+        before = pi.metrics()
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        while time.perf_counter() - t0 < seconds:
+            max_depth[0] = max(max_depth[0], pi._q.qsize())
+            time.sleep(0.005)
+        stop.set()
+        for t in threads:
+            t.join(timeout=30.0)
+            if t.is_alive():
+                # a wedged client would otherwise surface as a bogus
+                # "conservation violated" (its request stays admitted
+                # but unresolved when the books are read)
+                client_errors.append(f"{t.name}: wedged past join budget")
+        dt = time.perf_counter() - t0
+        after = pi.metrics()
+        return dt, {k: after[k] - before[k]
+                    for k in ("admitted", "completed", "shed", "failed",
+                              "rejected", "requests")}
+
+    # phase 1: measured capacity — few clients, nothing sheds
+    base_dt, base = run_phase(4, duration * 0.5, track=False)
+    # phase 2: ~2x the absorbable outstanding work, shedding expected
+    max_depth[0] = 0
+    over_dt, over = run_phase(clients, duration, track=True)
+    m = pi.metrics()
+    comps = _health.get_health().status()["components"]
+    stalled = [k for k, v in comps.items()
+               if k.startswith("bench_overload")
+               and v.get("status") != "ok"]
+    pi.shutdown()
+    if client_errors:
+        raise RuntimeError(f"overload client died: {client_errors[:3]}")
+    if m["admitted"] != m["completed"] + m["shed"] + m["failed"]:
+        # the books MUST balance — a leak here is a correctness bug, not
+        # a perf number
+        raise RuntimeError(f"conservation violated: {m}")
+    snap = lat.snapshot()
+    capacity_rps = base["completed"] / base_dt
+    offered = (over["requests"] or 1) / over_dt
+    shed_total = over["shed"] + over["rejected"]
+    return {
+        "value": snap["p99_ms"],
+        "unit": "p99_ms_admitted_under_overload",
+        "slo_ms": slo_ms,
+        "slo_met_p99": bool(snap["p99_ms"] is not None
+                            and snap["p99_ms"] <= slo_ms),
+        "capacity_requests_per_sec": round(capacity_rps, 1),
+        "offered_requests_per_sec": round(offered, 1),
+        "overdrive_outstanding": round(clients / absorbable, 2),
+        "completed_per_sec": round(over["completed"] / over_dt, 1),
+        "shed_total": shed_total,
+        "shed_rate": round(shed_total / max(over["requests"], 1), 4),
+        "shed_by": m["shed_by"],
+        "max_queue_depth": max_depth[0],
+        "queue_capacity": queue_capacity,
+        "queue_bounded": bool(max_depth[0] <= queue_capacity),
+        "watchdog_stalled_components": stalled,
+        "clients": clients,
+        "p50_ms": snap["p50_ms"],
+        "seconds": round(base_dt + over_dt, 3),
+    }
+
+
 def bench_input_pipeline(n_batches=48, batch=64, img=24, classes=10,
                          workers=4, io_ms=12.0):
     """Input-bound training, the one workload where ETL is deliberately ON
@@ -683,6 +845,7 @@ WORKLOADS = {
     "word2vec": bench_word2vec,
     "vgg16_keras_import": bench_vgg16,
     "parallel_inference": bench_parallel_inference,
+    "parallel_inference_overload": bench_parallel_inference_overload,
     "input_pipeline": bench_input_pipeline,
 }
 
@@ -697,6 +860,7 @@ TIMEOUTS = {
     "word2vec": 600,
     "vgg16_keras_import": 600,
     "parallel_inference": 420,
+    "parallel_inference_overload": 240,
     "input_pipeline": 300,
 }
 PROBE_TIMEOUT = 120  # tiny matmul + readback; generous for backend init
@@ -832,8 +996,10 @@ def _workload(name):
 
 
 def main():
-    t0 = time.time()
-    remaining = lambda: OVERALL_DEADLINE - (time.time() - t0)
+    # monotonic: the budget must not move when NTP slews the wall clock
+    # mid-run (lint CC007)
+    t0 = time.monotonic()
+    remaining = lambda: OVERALL_DEADLINE - (time.monotonic() - t0)
 
     workloads, errors = {}, {}
     backend = device = None
@@ -908,6 +1074,11 @@ if __name__ == "__main__":
         if sys.argv[1] == "--probe":
             _probe()
         else:
-            _workload(sys.argv[2])
+            name = sys.argv[2]
+            if "--overload" in sys.argv[3:]:
+                # `bench.py --workload parallel_inference --overload` is
+                # the graceful-degradation variant of a serving workload
+                name = f"{name}_overload"
+            _workload(name)
     else:
         main()

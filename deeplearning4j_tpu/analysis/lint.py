@@ -22,6 +22,13 @@ prove:
   CC006  print() in library code — the deeplearning4j_tpu logger is the
          only sanctioned channel (cli.py and bench.py are operator
          surfaces and exempt)
+  CC007  `time.time()` in deadline/timeout arithmetic — wall-clock
+         jumps (NTP slew, manual resets) silently shrink or stretch a
+         deadline computed from it; time.monotonic() is the only clock
+         deadlines may be built on. Detected when a statement both
+         calls `time.time()` and mentions a deadline-ish identifier
+         (deadline/timeout/expire/remaining/retry_after...); plain
+         timestamping (`"ts": time.time()`) stays legal.
 
 Findings carry stable names (`CODE:path:scope[#n]`, no line numbers) so
 scripts/lint.sh can diff them against the committed
@@ -59,6 +66,22 @@ THREAD_NAME_PREFIX = "dl4j-"
 # leading underscores, is queue-ish ("q", "queue", "handoff", "*_q", ...)
 _QUEUE_NAME = re.compile(r"^_*(q|queue|handoff|.*_q|.*_queue|.*_handoff)$")
 _LOCK_NAME = re.compile(r"(^|_)(lock|mutex)s?$", re.IGNORECASE)
+# identifiers that mark a statement as deadline/timeout arithmetic
+# (CC007): a `time.time()` in the same statement is wall-clock math on
+# a duration contract
+_DEADLINE_NAME = re.compile(
+    r"deadline|timeout|expire|expiry|remaining|retry_after|retry_by|"
+    r"stall_after|due_at", re.IGNORECASE)
+
+
+def _is_walltime_call(node: ast.Call) -> bool:
+    """`time.time()` — the wall clock. (A bare `time()` from
+    `from time import time` is rare in this repo and ambiguous with
+    user-defined callables, so only the dotted form is claimed.)"""
+    f = node.func
+    return (isinstance(f, ast.Attribute) and f.attr == "time"
+            and isinstance(f.value, ast.Name) and f.value.id == "time"
+            and not node.args and not node.keywords)
 
 
 def _is_queue_receiver(node: ast.expr) -> bool:
@@ -194,6 +217,51 @@ class _ModuleLinter(ast.NodeVisitor):
         self._visit_scope(node, node.name)
         self._class_stack.pop()
 
+    # -- CC007 statement tracking --------------------------------------------
+
+    # the statement currently being visited: CC007 is a statement-level
+    # judgment ("this statement does deadline math on the wall clock"),
+    # but the trigger is a Call node deep inside it
+    _stmt: Optional[ast.stmt] = None
+
+    def visit(self, node):
+        if isinstance(node, ast.stmt):
+            self._stmt = node
+        return super().visit(node)
+
+    # a compound statement's nested suites are separate statements with
+    # their own judgment — `if time.time() - last > 60:` must not become
+    # a finding just because its BODY mentions a timeout somewhere
+    _NESTED_SUITE_FIELDS = ("body", "orelse", "finalbody", "handlers")
+
+    @classmethod
+    def _mentions_deadline(cls, stmt: ast.stmt) -> bool:
+        """Any identifier in the statement's own expressions — name,
+        attribute, parameter, keyword argument — that reads as
+        deadline/timeout vocabulary. Nested suites are excluded (each
+        inner statement is judged on its own), and string constants
+        ('{"ts": time.time()}') deliberately do NOT count: timestamping
+        stays legal."""
+        roots = []
+        for field, value in ast.iter_fields(stmt):
+            if field in cls._NESTED_SUITE_FIELDS:
+                continue
+            for n in (value if isinstance(value, list) else [value]):
+                if isinstance(n, ast.AST):
+                    roots.append(n)
+        for root in roots:
+            for sub in ast.walk(root):
+                if isinstance(sub, ast.Name) \
+                        and _DEADLINE_NAME.search(sub.id):
+                    return True
+                if isinstance(sub, ast.Attribute) \
+                        and _DEADLINE_NAME.search(sub.attr):
+                    return True
+                if isinstance(sub, (ast.arg, ast.keyword)) \
+                        and sub.arg and _DEADLINE_NAME.search(sub.arg):
+                    return True
+        return False
+
     # -- CC001 bare except ---------------------------------------------------
 
     def visit_ExceptHandler(self, node):
@@ -244,6 +312,19 @@ class _ModuleLinter(ast.NodeVisitor):
                     "thread is neither daemon=True nor visibly joined",
                     "pass daemon=True (and still close/join it "
                     "deterministically where possible)")
+        # CC007: wall-clock deadline arithmetic. time.time() is only a
+        # finding when the SAME statement speaks deadline vocabulary —
+        # `deadline = time.time() + budget` is the bug (NTP slew moves
+        # the deadline), `{"ts": time.time()}` is legal timestamping.
+        if isinstance(node, ast.Call) and _is_walltime_call(node) \
+                and self._stmt is not None \
+                and self._mentions_deadline(self._stmt):
+            self._emit(
+                "CC007", ERROR, node,
+                "time.time() in deadline/timeout arithmetic — wall-clock "
+                "jumps silently shrink or stretch the deadline",
+                "build deadlines on time.monotonic(); keep time.time() "
+                "for human-facing timestamps only")
         # CC002: queue put/get without timeout in thread code
         if (self.runs_threads and isinstance(func, ast.Attribute)
                 and func.attr in ("put", "get")
@@ -399,7 +480,7 @@ def main(argv=None) -> int:
 
     ap = argparse.ArgumentParser(
         prog="deeplearning4j_tpu.analysis.lint",
-        description="concurrency/robustness lint (CC001-CC006)")
+        description="concurrency/robustness lint (CC001-CC007)")
     ap.add_argument("paths", nargs="*", default=None,
                     help=f"files/dirs to lint (default: {DEFAULT_TARGETS})")
     ap.add_argument("--json", dest="json_out", default=None, metavar="PATH",

@@ -483,6 +483,279 @@ def _preset_network(args):
                      "(resnet50|tiny_resnet|charlstm)")
 
 
+def _chaos_net(n_in: int = 8):
+    """Small dense net shared by the chaos presets: big enough to have a
+    real forward/backward, small enough that a replay run is seconds."""
+    from deeplearning4j_tpu.nn.conf import (
+        DenseLayer,
+        NeuralNetConfiguration,
+        OutputLayer,
+        Updater,
+    )
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    conf = (NeuralNetConfiguration.builder().seed(7).updater(Updater.SGD)
+            .learning_rate(0.05).weight_init("xavier").list()
+            .layer(DenseLayer(n_in=n_in, n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_in=8, n_out=3, activation="softmax",
+                               loss="mcxent"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _chaos_budget(plan) -> float:
+    """Join budget for a chaos run: generous base plus the longest hang
+    the plan can inject — a run past this is WEDGED, the one verdict a
+    chaos replay must never produce."""
+    hangs = [r.hang_seconds for r in plan.rules if r.kind == "hang"]
+    return 60.0 + (max(hangs) if hangs else 0.0)
+
+
+def _chaos_unhealthy(wait: float = 10.0) -> list:
+    """Components still not `ok` after the run — a hang release recovers
+    them asynchronously, so give the watchdog a scan or two to flip them
+    back before judging. The chaos process is fresh, so every registered
+    component belongs to the run under test."""
+    import time as _time
+
+    from deeplearning4j_tpu.utils import health as _health
+
+    def _bad():
+        comps = _health.get_health().status()["components"]
+        return sorted(k for k, v in comps.items()
+                      if v.get("status") != "ok")
+
+    healthy_by = _time.monotonic() + wait
+    unhealthy = _bad()
+    while unhealthy and _time.monotonic() < healthy_by:
+        _time.sleep(0.1)
+        unhealthy = _bad()
+    return unhealthy
+
+
+def _chaos_serving(plan, requests: int, clients: int,
+                   deadline_ms) -> dict:
+    """Serving preset: concurrent closed-loop clients against one
+    ParallelInference under the plan. Invariants checked: every client
+    terminates inside the budget, the books balance
+    (admitted == completed + shed + failed), and the serving components
+    end healthy."""
+    import threading
+
+    import numpy as np
+
+    from deeplearning4j_tpu.parallel.inference import (
+        DeadlineExceeded,
+        ParallelInference,
+        RequestRejected,
+    )
+    from deeplearning4j_tpu.utils import faultpoints as fp
+
+    n_in = 8
+    net = _chaos_net(n_in)
+    pi = ParallelInference(net, max_batch_size=4, batch_timeout_ms=2.0,
+                           queue_capacity=64, health_stall_after=20.0,
+                           component_prefix="chaos_cli")
+    counts = {"ok": 0, "fault": 0, "shed": 0, "error": 0}
+    lock = threading.Lock()
+    rng = np.random.default_rng(0)
+    reqs = [rng.standard_normal((1 + i % 4, n_in)).astype(np.float32)
+            for i in range(16)]
+    per = max(1, requests // clients)
+
+    def client(ci):
+        for j in range(per):
+            try:
+                pi.output(reqs[(ci * 7 + j) % len(reqs)],
+                          deadline_ms=deadline_ms)
+                k = "ok"
+            except fp.FaultInjected:
+                k = "fault"
+            except (DeadlineExceeded, RequestRejected):
+                k = "shed"
+            except Exception:
+                k = "error"
+            with lock:
+                counts[k] += 1
+
+    wedged = []
+    try:
+        pi.warmup((n_in,))
+        with fp.active(plan):
+            threads = [threading.Thread(target=client, args=(i,),
+                                        daemon=True,
+                                        name=f"dl4j-chaos-cli-{i}")
+                       for i in range(clients)]
+            for t in threads:
+                t.start()
+            budget = _chaos_budget(plan)
+            for t in threads:
+                t.join(timeout=budget)
+                if t.is_alive():
+                    wedged.append(t.name)
+        m = pi.metrics()
+        unhealthy = _chaos_unhealthy()
+    finally:
+        pi.shutdown()
+    return {
+        "workload": {"requests": per * clients, "clients": clients,
+                     "deadline_ms": deadline_ms, "outcomes": counts},
+        "metrics": {k: m[k] for k in ("admitted", "completed", "shed",
+                                      "failed", "rejected")},
+        "shed_by": m["shed_by"],
+        "conservation_ok":
+            m["admitted"] == m["completed"] + m["shed"] + m["failed"],
+        "wedged_threads": wedged,
+        "unhealthy_components": unhealthy,
+        "outcome": "wedged" if wedged else "recovered",
+    }
+
+
+def _chaos_training(plan, steps: int) -> dict:
+    """Training preset: one epoch over a multi-worker ETL iterator with
+    async checkpointing, under the plan — `etl_worker`, `device_put`,
+    `ckpt_write` (and `helper_fn` where helpers are registered) all sit
+    on this path. A fit that raises is a CLEAN failure; only a fit that
+    outlives the budget is a wedge."""
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.data.prefetch import ParallelDataSetIterator
+    from deeplearning4j_tpu.train.checkpoint import CheckpointListener
+    from deeplearning4j_tpu.utils import faultpoints as fp
+
+    n_in = 8
+    net = _chaos_net(n_in)
+    rng = np.random.default_rng(0)
+    base = [DataSet(rng.standard_normal((8, n_in)).astype(np.float32),
+                    np.eye(3, dtype=np.float32)[rng.integers(0, 3, 8)])
+            for _ in range(steps)]
+    ckdir = tempfile.mkdtemp(prefix="dl4j-chaos-ckpt-")
+    listener = CheckpointListener(
+        ckdir, every_n_iterations=max(2, steps // 4),
+        every_n_epochs=None, keep_last=2, async_save=True)
+    net.set_listeners(listener)
+    result = {}
+
+    def run():
+        try:
+            net.fit(ParallelDataSetIterator(base, workers=2,
+                                            stage="chaos_cli_etl"),
+                    epochs=1, async_prefetch=True)
+            result["outcome"] = "recovered"
+        except fp.FaultInjected as e:
+            result["outcome"] = "cleanly_failed"
+            result["failure"] = f"FaultInjected: {e}"
+        except Exception as e:
+            result["outcome"] = "cleanly_failed"
+            result["failure"] = f"{type(e).__name__}: {e}"
+
+    with fp.active(plan):
+        t = threading.Thread(target=run, daemon=True,
+                             name="dl4j-chaos-cli-fit")
+        t.start()
+        t.join(timeout=_chaos_budget(plan))
+        wedged = t.is_alive()
+    listener.close()
+    if wedged:
+        result["outcome"] = "wedged"
+    from deeplearning4j_tpu.utils.metrics import get_registry
+
+    scalars = get_registry().scalar_values()
+    return {
+        "workload": {"steps": steps, "checkpoint_dir": ckdir},
+        "checkpoint_write_failures": scalars.get(
+            "checkpoint_save_failures_total", 0.0),
+        "conservation_ok": True,  # no serving books in this preset
+        "wedged_threads": (["dl4j-chaos-cli-fit"] if wedged else []),
+        "unhealthy_components": _chaos_unhealthy(),
+        **result,
+    }
+
+
+def _chaos_default_plan(preset: str, seed: int):
+    from deeplearning4j_tpu.utils import faultpoints as fp
+
+    if preset == "serving":
+        # replica_forward only: the preset drives ParallelInference
+        # in-process, so an http_handler rule would never fire — exactly
+        # the vacuously-green rule faultpoints.py warns about
+        return (fp.FaultPlan(seed=seed)
+                .add("replica_forward", "error", p=0.08)
+                .add("replica_forward", "latency", p=0.2,
+                     latency_ms=10.0))
+    return (fp.FaultPlan(seed=seed)
+            .add("etl_worker", "latency", p=0.2, latency_ms=10.0)
+            .add("ckpt_write", "error", every_nth=2, max_fires=1)
+            .add("device_put", "latency", p=0.1, latency_ms=5.0))
+
+
+def cmd_chaos(args) -> int:
+    """Replay a seeded FaultPlan outside pytest (utils/faultpoints): run
+    the serving or training preset workload under the plan and report
+    the canonical event log plus the invariant verdict. Exit 0 when the
+    run ends recovered or cleanly failed with the serving books
+    balanced; 1 when an invariant broke (a wedge, a conservation
+    violation, a component left unhealthy). Two runs of the same plan
+    + preset produce the same event log — diff the --json artifacts to
+    prove a replay."""
+    import json as _json
+
+    from deeplearning4j_tpu.utils import faultpoints as fp
+
+    if args.plan:
+        with open(args.plan) as f:
+            plan = fp.FaultPlan.from_json(f.read())
+        if args.seed is not None:
+            plan.seed = int(args.seed)
+    else:
+        plan = _chaos_default_plan(args.preset, args.seed or 0)
+    if args.preset == "serving":
+        report = _chaos_serving(plan, args.requests, args.clients,
+                                args.deadline_ms)
+    else:
+        report = _chaos_training(plan, args.steps)
+    report = {
+        "preset": args.preset,
+        "plan": _json.loads(plan.to_json()),
+        "events": plan.event_log(),
+        "invocations": plan.invocations(),
+        **report,
+    }
+    ok = (report["outcome"] in ("recovered", "cleanly_failed")
+          and report["conservation_ok"]
+          and not report["unhealthy_components"])
+    report["verdict"] = "ok" if ok else "violated"
+    if args.json == "-":
+        print(_json.dumps(report, indent=2, default=str))
+    elif args.json:
+        with open(args.json, "w") as f:
+            _json.dump(report, f, indent=2, default=str)
+        print(f"wrote {args.json}")
+    else:
+        print(f"chaos[{args.preset}] seed={plan.seed} "
+              f"rules={len(plan.rules)}")
+        print(f"  injected: {len(report['events'])} fault(s) over "
+              f"{sum(report['invocations'].values())} point "
+              f"invocation(s)")
+        for e in report["events"][:20]:
+            print(f"    {e['point']}#{e['invocation']} {e['kind']} "
+                  f"(rule {e['rule']})")
+        if len(report["events"]) > 20:
+            print(f"    ... {len(report['events']) - 20} more")
+        if "metrics" in report:
+            print(f"  books: {report['metrics']} "
+                  f"(conserved: {report['conservation_ok']})")
+        if report.get("failure"):
+            print(f"  failure: {report['failure']}")
+        print(f"  outcome: {report['outcome']}  "
+              f"verdict: {report['verdict']}")
+    return 0 if ok else 1
+
+
 def cmd_lint(args) -> int:
     """Concurrency/robustness lint over source paths (analysis/lint.py,
     CC001-CC006). The t1 gate wraps this via scripts/lint.sh with the
@@ -647,6 +920,32 @@ def main(argv=None) -> int:
     d.add_argument("--json", default=None, metavar="PATH",
                    help="machine-readable findings ('-' = stdout)")
     d.set_defaults(fn=cmd_doctor)
+
+    ch = sub.add_parser(
+        "chaos",
+        help="replay a seeded FaultPlan over a preset workload "
+             "(utils/faultpoints; exit 1 on wedge/conservation "
+             "violation)")
+    ch.add_argument("--preset", required=True,
+                    choices=("serving", "training"),
+                    help="workload to run under the plan")
+    ch.add_argument("--plan", default=None, metavar="JSON",
+                    help="FaultPlan JSON file (default: a built-in plan "
+                         "for the preset)")
+    ch.add_argument("--seed", type=int, default=None,
+                    help="override the plan's seed (default plan: 0)")
+    ch.add_argument("--requests", type=int, default=60,
+                    help="serving preset: total requests")
+    ch.add_argument("--clients", type=int, default=6,
+                    help="serving preset: concurrent client threads")
+    ch.add_argument("--deadline-ms", type=float, default=2000.0,
+                    help="serving preset: per-request deadline budget")
+    ch.add_argument("--steps", type=int, default=24,
+                    help="training preset: batches in the epoch")
+    ch.add_argument("--json", default=None, metavar="PATH",
+                    help="machine-readable report ('-' = stdout) — diff "
+                         "two runs' `events` to prove a replay")
+    ch.set_defaults(fn=cmd_chaos)
 
     ln = sub.add_parser(
         "lint",

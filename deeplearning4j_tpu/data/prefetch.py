@@ -48,6 +48,7 @@ from deeplearning4j_tpu.data.iterators import (
     _get_abortable,
     _put_abortable,
 )
+from deeplearning4j_tpu.utils import faultpoints as _faults
 from deeplearning4j_tpu.utils import health as _health
 from deeplearning4j_tpu.utils import metrics as _metrics
 
@@ -199,6 +200,12 @@ class ParallelDataSetIterator(DataSetIterator):
                             seq = seq_box[0]
                             seq_box[0] += 1
                     with hb.busy():
+                        # chaos hook: an `error` fault is a raising ETL
+                        # transform (propagates in-position to the
+                        # consumer); `hang` is the wedged-worker case
+                        # the shared heartbeat's oldest-slot rule
+                        # detects
+                        _faults.fault_point("etl_worker", stage=self.stage)
                         out = (self.transform(item) if self.transform
                                else item)
                 except BaseException as e:
@@ -364,6 +371,11 @@ class DevicePrefetchIterator(DataSetIterator):
     def _stage(self, ds, target):
         if getattr(ds, "_pipeline_staged", False):
             return ds  # already staged upstream (e.g. a nested pipeline)
+        # chaos hook: an `error` fault is a failed host->device transfer
+        # (surfaces in the consumer, fit fails loudly); `hang` is a
+        # device_put that never returns — the stale busy slot the
+        # prefetch heartbeat exists to catch
+        _faults.fault_point("device_put", stage=self.stage)
         if callable(self.placement):
             out = _carry_metadata(ds, self.placement(ds))
         else:

@@ -16,6 +16,7 @@ import dataclasses
 import logging
 from typing import Callable, Dict, Optional
 
+from deeplearning4j_tpu.utils import faultpoints as _faults
 from deeplearning4j_tpu.utils import metrics as _metrics
 from deeplearning4j_tpu.utils import tracing as _tracing
 
@@ -105,6 +106,11 @@ def get_helper(op: str, **ctx) -> Optional[Callable]:
 
     def guarded(*args, **kwargs):
         try:
+            # chaos hook: an `error` fault here IS a raising helper fn —
+            # it rides the real auto-disable + HelperError + builtin-
+            # retry path below, so injected kernel failures exercise
+            # exactly the degradation the PR 2 kill switch promises
+            _faults.fault_point("helper_fn", op=op, helper=h.name)
             return h.fn(*args, **kwargs)
         except Exception as e:
             h.enabled = False

@@ -22,8 +22,11 @@ from deeplearning4j_tpu.parallel.mesh import (
 )
 from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
 from deeplearning4j_tpu.parallel.inference import (
+    DeadlineExceeded,
     InferenceMode,
     ParallelInference,
+    ReplicaPool,
+    RequestRejected,
     RequestValidationError,
     power_of_two_buckets,
 )
@@ -48,7 +51,10 @@ __all__ = [
     "replicated",
     "ParallelWrapper",
     "ParallelInference",
+    "ReplicaPool",
     "InferenceMode",
     "RequestValidationError",
+    "RequestRejected",
+    "DeadlineExceeded",
     "power_of_two_buckets",
 ]

@@ -30,16 +30,36 @@ serving-specific mechanisms go beyond it:
   assembly of group k+1 overlaps device execution of group k (double
   buffering — same idea as the training-side async prefetch,
   data/iterators.AsyncDataSetIterator).
+
+* **Deadlines + admission control + load shedding** — every request may
+  carry a `deadline_ms` budget (or inherits `default_deadline_ms`), and
+  expired work is SHED at every stage instead of served late: admission
+  (already expired, queue at `queue_capacity`, or predicted to miss —
+  estimated wait is queued-examples-in-groups × the rolling p50 batch
+  latency), the collector (expired while queued), the dispatcher
+  (expired before the device forward), and the ReplicaPool resubmit
+  loop (expired mid-failover, or out of retry budget). Under sustained
+  overload the queue depth stays bounded and excess load turns into
+  fast, explicit rejections (HTTP 429 + Retry-After at the REST layer)
+  rather than an unbounded queue where EVERY request times out
+  client-side. Accounting is exact and scrape-able:
+  `serving_shed_total{stage,reason}` plus per-endpoint
+  admitted/completed/shed/failed counters obeying the conservation law
+  `admitted == completed + shed + failed` (rejections happen before
+  admission and are counted separately) — tests/test_chaos.py asserts
+  it under injected faults.
 """
 
 from __future__ import annotations
 
 import logging
+import math
 import queue
 import threading
 import time
 import weakref
 from concurrent.futures import Future
+from concurrent.futures import TimeoutError as FutureTimeoutError
 from typing import List, Optional, Sequence
 
 import jax
@@ -53,6 +73,7 @@ from deeplearning4j_tpu.parallel.mesh import (
     replicated,
 )
 from deeplearning4j_tpu.utils import blackbox as _blackbox
+from deeplearning4j_tpu.utils import faultpoints as _faults
 from deeplearning4j_tpu.utils import health as _health
 from deeplearning4j_tpu.utils import metrics as _metrics
 from deeplearning4j_tpu.utils import tracing as _tracing
@@ -61,8 +82,25 @@ from deeplearning4j_tpu.utils.concurrency import (
     get_abortable,
     put_abortable,
 )
+from deeplearning4j_tpu.utils.latency import LatencyTracker
 
 logger = logging.getLogger("deeplearning4j_tpu")
+
+# how long a deadline-carrying caller waits PAST its deadline before
+# shedding its own future (stage="wait"): long enough that a live
+# collector/dispatcher always sheds first (keeping the stage-precise
+# books and the device-work saving), short enough that a wedged pipeline
+# cannot hold callers hostage
+_WAIT_SHED_GRACE = 0.25
+
+# the wait estimator is fed ONLY by completed forwards, and admission
+# consults it before admitting — so a rolling p50 pushed past every
+# caller's deadline by one bad window (GIL stall, transient device
+# slowness) would starve itself of the very samples that let it
+# recover: 100% shed, forever. When the pipeline is idle and no forward
+# has landed within max(4 x p50, this floor), the estimate is STALE and
+# admission lets one probe through to re-learn reality
+_ESTIMATOR_STALE_MIN = 1.0
 
 
 class InferenceMode:
@@ -74,6 +112,36 @@ class RequestValidationError(ValueError):
     """The REQUEST was malformed (empty, or feature shape mismatching the
     endpoint's) — distinguishes client faults from server-side ValueErrors
     so REST layers can map 400 vs 500 correctly."""
+
+
+class DeadlineExceeded(RuntimeError):
+    """The request's deadline expired before its result could be
+    produced — shed, not served late. `stage` names where it was caught
+    (admission / collector / dispatch / resubmit, or `wait`: the
+    caller's own bounded wait on a wedged pipeline). REST maps this to
+    429: the work was never done, so the client may retry with a fresh
+    budget."""
+
+    def __init__(self, message: str, stage: str = "admission",
+                 retry_after: float = 0.0):
+        super().__init__(message)
+        self.stage = stage
+        self.retry_after = float(retry_after)
+
+
+class RequestRejected(RuntimeError):
+    """Admission control refused the request: the queue is at capacity,
+    or the estimated wait (queue depth × rolling p50 batch latency)
+    already exceeds the request's remaining deadline. `retry_after` is
+    the server's wait estimate in seconds — the Retry-After hint the
+    REST layer returns with the 429."""
+
+    def __init__(self, message: str, reason: str = "queue_full",
+                 retry_after: float = 0.0, stage: str = "admission"):
+        super().__init__(message)
+        self.reason = reason
+        self.retry_after = float(retry_after)
+        self.stage = stage
 
 
 class ReplicaUnavailable(RuntimeError):
@@ -117,11 +185,20 @@ class ParallelInference:
         handoff_capacity: int = 2,
         health_stall_after: float = 30.0,
         component_prefix: str = "serving",
+        queue_capacity: int = 1024,
+        default_deadline_ms: Optional[float] = None,
     ):
         self.model = model
         self.mesh = mesh if mesh is not None else data_parallel_mesh()
         self.mode = inference_mode
         self.max_batch_size = int(max_batch_size)
+        # overload protection: the request queue is BOUNDED (capacity
+        # enforced at admission, under the lock — the queue object stays
+        # unbounded so the shutdown sentinel can never block) and every
+        # request may carry a deadline. 0 disables the bound.
+        self.queue_capacity = max(0, int(queue_capacity))
+        self.default_deadline_ms = (None if default_deadline_ms is None
+                                    else float(default_deadline_ms))
         if self.max_batch_size < 1:
             raise ValueError(
                 f"max_batch_size must be >= 1, got {max_batch_size}")
@@ -174,7 +251,28 @@ class ParallelInference:
             "batches": 0,
             "oversized": 0,
             "bucket_hits": {b: 0 for b in self.buckets},
+            # exact request accounting (the conservation law):
+            #   admitted == completed + shed + failed
+            # `rejected` counts admission-control refusals — those
+            # happened BEFORE admission, so they sit outside the law
+            "admitted": 0,
+            "completed": 0,
+            "shed": 0,
+            "failed": 0,
+            "rejected": 0,
+            "shed_by": {},  # "stage/reason" -> count
         }
+        # examples currently waiting in _q (admission's queue-depth
+        # estimate in GROUP units: examples / max_batch_size)
+        self._queued_examples = 0
+        # rolling device-forward latency: the p50 here × groups-ahead is
+        # the admission-control wait estimate
+        self._batch_lat = LatencyTracker(window=64)
+        # monotonic time the last counted forward landed (None until the
+        # first): the staleness clock for the estimator-poison probe.
+        # Written by the dispatcher without the lock (GIL-atomic float
+        # store), read under it at admission
+        self._last_forward_mono: Optional[float] = None
         # shared-registry serving instruments (same registry as training's
         # fit_step_* / compile_total — ONE scrape sees both). Children are
         # resolved here once; the request path only touches the cached
@@ -197,6 +295,27 @@ class ParallelInference:
             "collector time blocked handing a prepared group to the "
             "dispatcher (device a full group behind = backpressure)"
         ).labels()
+        self._m_shed = reg.counter(
+            "serving_shed_total",
+            "requests shed instead of served late, by pipeline stage "
+            "and reason", ("stage", "reason"))
+        self._m_admitted = reg.counter(
+            "serving_admitted_total",
+            "requests past admission control (the conservation law's "
+            "left-hand side)").labels()
+        self._m_probe = reg.counter(
+            "serving_admission_probe_total",
+            "predicted-late requests admitted anyway because the wait "
+            "estimate was stale (idle pipeline, no recent forward) — "
+            "the self-healing path out of a poisoned rolling p50"
+        ).labels()
+        self._m_completed = reg.counter(
+            "serving_completed_total",
+            "admitted requests resolved with a result").labels()
+        self._m_failed = reg.counter(
+            "serving_failed_total",
+            "admitted requests resolved with an error "
+            "(model/abort/shutdown)").labels()
         ref = weakref.ref(self)
         reg.gauge(
             "serving_queue_depth",
@@ -232,11 +351,25 @@ class ParallelInference:
 
     # -- public --------------------------------------------------------------
 
-    def output(self, x):
+    def output(self, x, deadline_ms: Optional[float] = None):
         """Thread-safe inference. In BATCHED mode the call may be fused
         with concurrent callers' batches (reference:
-        BatchedInferenceObservable)."""
+        BatchedInferenceObservable). `deadline_ms` is the request's
+        total latency budget from this call (falls back to
+        `default_deadline_ms`; None = no deadline): a request that
+        cannot make it is shed — DeadlineExceeded / RequestRejected —
+        instead of served late."""
         xx = np.asarray(x)
+        if deadline_ms is None:
+            deadline_ms = self.default_deadline_ms
+        elif not math.isfinite(float(deadline_ms)):
+            # a NaN budget makes every deadline comparison False: the
+            # request would be admitted, then unconditionally shed in
+            # the collector — a malformed request, not a shed
+            raise RequestValidationError(
+                f"deadline_ms must be finite, got {deadline_ms!r}")
+        deadline = (None if deadline_ms is None
+                    else time.monotonic() + float(deadline_ms) / 1e3)
         with self._lock:
             # shutdown check and enqueue under ONE lock: a request admitted
             # here is visible to shutdown()'s drain, so its Future always
@@ -264,18 +397,207 @@ class ParallelInference:
             self._stats["examples"] += xx.shape[0]
             self._m_requests.inc()
             self._m_examples.inc(xx.shape[0])
+            fusable = (self.mode == InferenceMode.BATCHED
+                       and xx.shape[0] <= self.max_batch_size)
+            # -- admission control (still under the lock: the queue-depth
+            # facts it reads are mutated under it) --------------------------
+            now = time.monotonic()
+            if deadline is not None and now >= deadline:
+                self._shed_locked("admission", "expired")
+                raise DeadlineExceeded(
+                    "deadline expired before admission",
+                    stage="admission")
+            # one percentile pass (a sort under the admission lock)
+            # shared by the wait estimate, the staleness check, and the
+            # Retry-After hint — and skipped entirely on the no-deadline
+            # below-capacity fast path, where no decision would read it
+            need_estimate = fusable and (
+                deadline is not None
+                or (self.queue_capacity
+                    and self._q.qsize() >= self.queue_capacity))
+            p50 = (self._batch_lat.percentile_seconds(50)
+                   if need_estimate else None)
+            est_wait = (self._estimate_wait_locked(p50)
+                        if need_estimate else 0.0)
+            if fusable and self.queue_capacity \
+                    and self._q.qsize() >= self.queue_capacity:
+                self._shed_locked("admission", "queue_full")
+                raise RequestRejected(
+                    f"request queue at capacity "
+                    f"({self.queue_capacity} requests)",
+                    reason="queue_full", retry_after=est_wait)
+            if fusable and deadline is not None \
+                    and now + est_wait > deadline:
+                if not self._estimator_stale_locked(now, p50):
+                    self._shed_locked("admission", "predicted_late")
+                    raise RequestRejected(
+                        f"estimated wait {est_wait * 1e3:.0f}ms exceeds "
+                        f"the request's remaining deadline "
+                        f"{(deadline - now) * 1e3:.0f}ms",
+                        reason="predicted_late", retry_after=est_wait)
+                # stale estimate + idle pipeline: admit this request as a
+                # probe so the rolling p50 re-learns post-stall reality
+                # (it may be served late — bounded by the wait backstop —
+                # but without it a poisoned estimator sheds 100% forever).
+                # The enqueue below makes the pipeline non-idle, so
+                # concurrent callers go back to shedding: one probe per
+                # staleness window, not a floodgate
+                self._m_probe.inc()
+            self._stats["admitted"] += 1
+            self._m_admitted.inc()
             fut: Optional[Future] = None
-            if (self.mode == InferenceMode.BATCHED
-                    and xx.shape[0] <= self.max_batch_size):
+            if fusable:
                 fut = Future()
-                # put_nowait: the request queue is unbounded, so this is
-                # exactly `put` — minus the lint-rejected blocking form
-                self._q.put_nowait((xx, fut))
+                self._queued_examples += xx.shape[0]
+                # put_nowait: the queue OBJECT is unbounded (the capacity
+                # bound is the admission check above), so this is exactly
+                # `put` — minus the lint-rejected blocking form
+                self._q.put_nowait((xx, fut, deadline))
         if fut is not None:
-            return fut.result()
+            if deadline is None:
+                return fut.result()
+            # bounded wait: the collector/dispatcher are the PRIMARY
+            # shedders (they see the expiry first while the pipeline is
+            # alive, and their skip saves the device work) — but when
+            # the pipeline itself wedges nothing downstream will ever
+            # touch the future, so after a short grace past the deadline
+            # the waiter sheds it here. _fail is race-safe: a concurrent
+            # resolve/shed that beat us wins and is what the caller gets
+            try:
+                return fut.result(
+                    timeout=max(0.0, deadline - time.monotonic())
+                    + _WAIT_SHED_GRACE)
+            except FutureTimeoutError:
+                exc = DeadlineExceeded(
+                    "deadline expired waiting on a stalled pipeline",
+                    stage="wait")
+                if self._fail(fut, exc, outcome="shed", stage="wait",
+                              reason="expired"):
+                    raise exc from None
+                return fut.result()
         # SEQUENTIAL mode, or an oversized request: run it alone instead of
-        # overshooting a fused group arbitrarily (device work off-lock)
-        return self._run(xx)
+        # overshooting a fused group arbitrarily (device work off-lock).
+        # The unfused path honors the deadline like the fused one does:
+        # expired before the forward = dispatch-stage shed (saves the
+        # device work); finished past deadline + grace = wait-stage shed
+        # (the fused waiter's backstop — a late result is never served)
+        if deadline is not None and time.monotonic() >= deadline:
+            self._count_outcome("shed", stage="dispatch", reason="expired")
+            raise DeadlineExceeded(
+                "deadline expired before the unfused forward",
+                stage="dispatch")
+        try:
+            out = self._run(xx)
+        except BaseException:
+            self._count_outcome("failed")
+            raise
+        if deadline is not None \
+                and time.monotonic() >= deadline + _WAIT_SHED_GRACE:
+            self._count_outcome("shed", stage="wait", reason="expired")
+            raise DeadlineExceeded(
+                "deadline expired during the unfused forward",
+                stage="wait")
+        self._count_outcome("completed")
+        return out
+
+    # -- overload accounting --------------------------------------------------
+
+    def _estimate_wait_locked(self, p50: Optional[float]) -> float:
+        """Expected queue wait for a newly admitted request: groups
+        ahead of it — queued examples at bucket granularity, plus the
+        already-assembled groups parked in the handoff, plus the group
+        the device holds — × `p50`, the caller-supplied rolling p50
+        device-forward latency (computed once per admission: the
+        percentile pass sorts the window under the admission lock).
+        (The group in the collector's hands stays invisible: the
+        estimate is honest to ±1 group.) Zero until the first forward
+        lands — cold admission is optimistic."""
+        if p50 is None:
+            return 0.0
+        groups_ahead = (self._queued_examples / float(self.max_batch_size)
+                        + self._handoff.qsize())
+        return (groups_ahead + 1.0) * p50
+
+    def _estimator_stale_locked(self, now: float,
+                                p50: Optional[float]) -> bool:
+        """True when the wait estimate can no longer be trusted: the
+        pipeline is idle (nothing queued, nothing handed off) yet no
+        forward has landed within max(4 x p50, _ESTIMATOR_STALE_MIN).
+        That shape is the post-stall poison — one contended window
+        pushed the rolling p50 past every deadline, admission went to
+        100% shed, and the tracker is starved of the fresh samples that
+        would let it recover. (Only reached when a p50 exists: with an
+        empty tracker the estimate is 0 and nothing is predicted late.)"""
+        if self._queued_examples or self._handoff.qsize():
+            return False
+        if p50 is None:
+            return False
+        last = self._last_forward_mono
+        return last is None \
+            or now - last > max(4.0 * p50, _ESTIMATOR_STALE_MIN)
+
+    def estimated_wait(self) -> float:
+        with self._lock:
+            return self._estimate_wait_locked(
+                self._batch_lat.percentile_seconds(50))
+
+    def _shed_locked(self, stage: str, reason: str,
+                     admitted: bool = False):
+        """Book one shed under the (already-held) lock. Post-admission
+        sheds land in `shed` (the conservation law's term); admission
+        refusals land in `rejected` — the request never entered the
+        system. Both feed serving_shed_total{stage,reason}."""
+        key = f"{stage}/{reason}"
+        self._stats["shed_by"][key] = self._stats["shed_by"].get(key, 0) + 1
+        self._stats["shed" if admitted else "rejected"] += 1
+        self._m_shed.labels(stage, reason).inc()
+
+    def _count_outcome(self, outcome: str, stage: Optional[str] = None,
+                       reason: Optional[str] = None):
+        with self._lock:
+            if outcome == "shed":
+                self._shed_locked(stage, reason, admitted=True)
+                return
+            self._stats[outcome] += 1
+        (self._m_completed if outcome == "completed"
+         else self._m_failed).inc()
+
+    def _resolve(self, fut: Future, value) -> bool:
+        """Deliver a result; count `completed` only when OUR set won (an
+        abort may have failed the future concurrently — whoever's set
+        lands does the counting, so every future is counted once)."""
+        try:
+            fut.set_result(value)
+        except Exception:
+            return False
+        self._count_outcome("completed")
+        return True
+
+    def _fail(self, fut: Future, exc: Exception, outcome: str = "failed",
+              stage: Optional[str] = None,
+              reason: Optional[str] = None) -> bool:
+        try:
+            fut.set_exception(exc)
+        except Exception:
+            return False
+        self._count_outcome(outcome, stage, reason)
+        return True
+
+    def _dequeued(self, item):
+        with self._lock:
+            self._queued_examples -= item[0].shape[0]
+
+    def _shed_if_expired(self, item, stage: str) -> bool:
+        """Shed a queued request whose deadline passed while it waited —
+        serving it would burn device time on a result nobody reads."""
+        _, fut, deadline = item
+        if deadline is None or time.monotonic() < deadline:
+            return False
+        self._fail(
+            fut,
+            DeadlineExceeded(f"deadline expired in {stage}", stage=stage),
+            outcome="shed", stage=stage, reason="expired")
+        return True
 
     def warmup(self, feature_shape: Optional[Sequence[int]] = None,
                dtype=np.float32):
@@ -315,11 +637,20 @@ class ParallelInference:
                 "batches": self._stats["batches"],
                 "oversized": self._stats["oversized"],
                 "bucket_hits": dict(self._stats["bucket_hits"]),
+                "admitted": self._stats["admitted"],
+                "completed": self._stats["completed"],
+                "shed": self._stats["shed"],
+                "failed": self._stats["failed"],
+                "rejected": self._stats["rejected"],
+                "shed_by": dict(self._stats["shed_by"]),
             }
         m["buckets"] = list(self.buckets)
         m["max_batch_size"] = self.max_batch_size
         m["batch_timeout_ms"] = self.batch_timeout * 1e3
         m["queue_depth"] = self._q.qsize() + self._handoff.qsize()
+        m["queue_capacity"] = self.queue_capacity
+        m["default_deadline_ms"] = self.default_deadline_ms
+        m["estimated_wait_ms"] = round(self.estimated_wait() * 1e3, 3)
         m["forward_compiles"] = int(
             getattr(self.model, "output_compile_count", 0))
         return m
@@ -381,11 +712,7 @@ class ParallelInference:
         # errors
         err = RuntimeError(f"ParallelInference {reason} (in flight)")
         for fut in list(self._inflight):
-            if not fut.done():
-                try:
-                    fut.set_exception(err)
-                except Exception:
-                    pass  # lost the race against a completing forward
+            self._fail(fut, err)  # no-op if it lost to a completing forward
         self._sweep_futures(ReplicaUnavailable(f"ParallelInference {reason}"))
         for hb in (self._hb_collect, self._hb_dispatch):
             if hb is not None:
@@ -398,14 +725,15 @@ class ParallelInference:
                     item = q.get_nowait()
                 except queue.Empty:
                     break
-                futs = ([item[1]] if q is self._q else item[3]) \
-                    if item is not None else []
+                if item is None:
+                    continue
+                if q is self._q:
+                    self._dequeued(item)
+                    futs = [item[1]]
+                else:
+                    futs = item[3]
                 for fut in futs:
-                    if not fut.done():
-                        try:
-                            fut.set_exception(err)
-                        except Exception:
-                            pass
+                    self._fail(fut, err)
 
     # -- internals -----------------------------------------------------------
 
@@ -448,7 +776,14 @@ class ParallelInference:
         BATCHED dispatcher) go through: sharded dispatch, host readback,
         pad rows sliced off. A multi-output ComputationGraph returns a
         list; the batch slice applies per output, not to the list."""
+        t0 = time.perf_counter()
         try:
+            # chaos hook: an `error` fault here is a device-forward
+            # failure (the whole fused group fails; a ReplicaPool retries
+            # nothing — in-flight is the one non-retryable stage); a
+            # `hang` is the wedged-dispatcher scenario the watchdog and
+            # eviction path exist for
+            _faults.fault_point("replica_forward", bucket=b, rows=n)
             with _tracing.span("serve/forward", bucket=b, rows=n):
                 out = self.model.output(
                     jax.device_put(padded, batch_sharded(self.mesh)))
@@ -471,6 +806,14 @@ class ParallelInference:
         with self._lock:
             self._shape_confirmed = True
         if count:  # after the forward: a failed batch is not a served one
+            # rolling batch latency (successful SERVED batches only): the
+            # admission-control wait estimate reads its p50. Warmup runs
+            # (count=False) are excluded — they pay trace+compile, and a
+            # window seeded with ~1s compile samples would predicted-late
+            # every deadline-carrying request before real traffic ever
+            # lands a steady-state sample
+            self._batch_lat.record(time.perf_counter() - t0)
+            self._last_forward_mono = time.monotonic()
             self._count_batch(b)
         return out
 
@@ -498,15 +841,12 @@ class ParallelInference:
                                    and not self._dispatch_t.is_alive())))
             return True
         except QueueAborted:
+            err = ReplicaUnavailable(
+                "ParallelInference dispatcher unavailable "
+                "(died or aborted)")
             for fut in futs:
-                if not fut.done():
-                    try:
-                        # never dispatched — retryable on another replica
-                        fut.set_exception(ReplicaUnavailable(
-                            "ParallelInference dispatcher unavailable "
-                            "(died or aborted)"))
-                    except Exception:
-                        pass
+                # never dispatched — retryable on another replica
+                self._fail(fut, err)
             return False
 
     # BATCHED pipeline, stage 1: drain + concatenate + pad on the host
@@ -526,9 +866,14 @@ class ParallelInference:
                     item = get_abortable(self._q, abort=self._abort)
                 except QueueAborted:
                     return  # abort(): sweep fails whatever is queued
+                if item is not None:
+                    self._dequeued(item)
             if item is None:
                 self._put_handoff(None)
                 return
+            # shed, don't serve, a request that expired while queued
+            if self._shed_if_expired(item, "collector"):
+                continue
             # work in hand: from here until the handoff completes this
             # thread owes progress (a block inside _emit's handoff put
             # means the device is wedged — exactly what should degrade)
@@ -545,6 +890,9 @@ class ParallelInference:
                         self._emit(group)
                         self._put_handoff(None)
                         return
+                    self._dequeued(nxt)
+                    if self._shed_if_expired(nxt, "collector"):
+                        continue
                     if (count + nxt[0].shape[0] > self.max_batch_size
                             or nxt[0].shape[1:] != item[0].shape[1:]):
                         # would overflow max_batch_size (and possibly fall
@@ -568,14 +916,14 @@ class ParallelInference:
                      if len(group) > 1 else group[0][0])
             padded, n, b = self._pad(batch)
         except BaseException as e:  # propagate to all waiting callers
-            for _, fut in group:
-                if not fut.done():
-                    fut.set_exception(e)
+            for _, fut, _ in group:
+                self._fail(fut, e)
             return
         t0 = time.perf_counter()
-        futs = [fut for _, fut in group]
+        futs = [fut for _, fut, _ in group]
         self._put_handoff(
-            (padded, n, b, futs, [g[0].shape[0] for g in group]), futs)
+            (padded, n, b, futs, [g[0].shape[0] for g in group],
+             [g[2] for g in group]), futs)
         self._m_handoff.observe(time.perf_counter() - t0)
 
     # BATCHED pipeline, stage 2: device forward + scatter results
@@ -596,30 +944,44 @@ class ParallelInference:
                 return
             if work is None:
                 return
-            padded, n, b, futs, sizes = work
+            padded, n, b, futs, sizes, deadlines = work
+            # shed expired members BEFORE burning device time on them;
+            # when the WHOLE group expired while the device was behind,
+            # skip the forward entirely (that skip is what keeps an
+            # overloaded device from serving a backlog nobody is
+            # waiting for). The padded batch still carries the shed
+            # rows when only some expired — harmless: their results are
+            # simply not delivered.
+            now = time.monotonic()
+            live = [fut for fut, d in zip(futs, deadlines)
+                    if d is None or now < d]
+            for fut, d in zip(futs, deadlines):
+                if d is not None and now >= d:
+                    self._fail(
+                        fut,
+                        DeadlineExceeded("deadline expired before the "
+                                         "device forward",
+                                         stage="dispatch"),
+                        outcome="shed", stage="dispatch", reason="expired")
+            if not live:
+                continue
             # busy only while a group is in hand: a forward that never
             # returns (device wedge) leaves this slot stale and the
             # watchdog flips serving_dispatcher to degraded/unhealthy
             with self._hb_dispatch.busy():
-                self._inflight = futs
+                self._inflight = live
                 try:
                     out = self._forward_padded(padded, n, b)
                     off = 0
                     for fut, k in zip(futs, sizes):
-                        try:  # abort() may fail the future concurrently
-                            if not fut.done():
-                                fut.set_result(
-                                    self._rows(out, off, off + k))
-                        except Exception:
-                            pass
+                        # abort() may fail the future concurrently;
+                        # _resolve counts only when our set wins
+                        if not fut.done():
+                            self._resolve(fut, self._rows(out, off, off + k))
                         off += k
                 except BaseException as e:  # propagate to waiting callers
                     for fut in futs:
-                        if not fut.done():
-                            try:
-                                fut.set_exception(e)
-                            except Exception:
-                                pass
+                        self._fail(fut, e)
                 finally:
                     self._inflight = []
 
@@ -670,6 +1032,9 @@ class ReplicaPool:
         model_factory=None,
         auto_heal: bool = True,
         retry_window: float = 5.0,
+        retry_budget: int = 4,
+        queue_capacity: int = 1024,
+        default_deadline_ms: Optional[float] = None,
     ):
         if model is None and model_factory is None:
             raise ValueError("ReplicaPool needs a model or a model_factory")
@@ -679,6 +1044,9 @@ class ReplicaPool:
         self.component_prefix = component_prefix
         self.auto_heal = bool(auto_heal)
         self.retry_window = float(retry_window)
+        # resubmits-per-request cap: an eviction storm must not turn one
+        # request into unbounded retry load (failover amplification)
+        self.retry_budget = max(0, int(retry_budget))
         self._factory = (model_factory if model_factory is not None
                          else (lambda: model))
         self._pi_kwargs = dict(
@@ -686,7 +1054,9 @@ class ReplicaPool:
             max_batch_size=int(max_batch_size),
             batch_timeout_ms=float(batch_timeout_ms), buckets=buckets,
             handoff_capacity=handoff_capacity,
-            health_stall_after=health_stall_after)
+            health_stall_after=health_stall_after,
+            queue_capacity=queue_capacity,
+            default_deadline_ms=default_deadline_ms)
         self._lock = threading.Lock()
         self._rr = 0
         self._gen = [0] * self.n_replicas
@@ -709,9 +1079,25 @@ class ReplicaPool:
             "serving_replica_rerouted_total",
             "requests retried on a sibling after a retryable replica "
             "failure (never user-visible)").labels()
+        self._m_shed = reg.counter(
+            "serving_shed_total",
+            "requests shed instead of served late, by pipeline stage "
+            "and reason", ("stage", "reason"))
         self._gauge = reg.gauge(
             "serving_replicas_in_rotation",
             "replicas currently taking traffic").labels()
+        # pool-level sheds (resubmit stage) so metrics()["shed_by"]
+        # mirrors serving_shed_total — the replicas never see these
+        self._pool_shed_by: dict = {}
+        # evicted replicas' final books, folded in at eviction time so
+        # the JSON aggregate keeps agreeing with the registry counters
+        # (which survive respawn via get_or_create) after an eviction
+        self._retired: dict = {
+            k: 0 for k in ("requests", "examples", "batches", "oversized",
+                           "admitted", "completed", "shed", "failed",
+                           "rejected")}
+        self._retired["shed_by"] = {}
+        self._retired["bucket_hits"] = {}
         # slots hold None while a replica is mid-respawn (out of rotation)
         self._replicas: List[Optional[ParallelInference]] = [None] * \
             self.n_replicas
@@ -759,13 +1145,32 @@ class ReplicaPool:
                     return pi
         return None
 
-    def output(self, x):
+    def _pool_shed(self, reason: str):
+        """Book a resubmit-stage shed on the pool's own ledger AND the
+        shared serving_shed_total family, so the JSON metrics() books
+        agree with the Prometheus scrape and the 429 the caller gets."""
+        with self._lock:
+            key = f"resubmit/{reason}"
+            self._pool_shed_by[key] = self._pool_shed_by.get(key, 0) + 1
+        self._m_shed.labels("resubmit", reason).inc()
+
+    def output(self, x, deadline_ms: Optional[float] = None):
         """Thread-safe inference with failover: retryable replica
         failures (eviction races, mid-respawn gaps) are resubmitted on a
-        healthy sibling inside `retry_window`; only non-retryable
-        failures — a group already inside a device forward at eviction
-        time, or a genuine model error — reach the caller."""
-        deadline = time.monotonic() + self.retry_window
+        healthy sibling — but each request spends a bounded
+        `retry_budget` of resubmits and never retries past its own
+        deadline, so a failover storm cannot multiply offered load.
+        Non-retryable failures — a group already inside a device forward
+        at eviction time, a genuine model error, or an admission shed
+        (DeadlineExceeded / RequestRejected: retrying a load-shed
+        request IS the amplification admission control exists to stop)
+        — reach the caller directly."""
+        req_deadline = (None if deadline_ms is None
+                        else time.monotonic() + float(deadline_ms) / 1e3)
+        retry_by = time.monotonic() + self.retry_window
+        if req_deadline is not None:
+            retry_by = min(retry_by, req_deadline)
+        resubmits = 0
         last: Optional[Exception] = None
         while True:
             pi = self._pick()
@@ -773,13 +1178,36 @@ class ReplicaPool:
                 last = last or RuntimeError("no replica in rotation")
             else:
                 try:
-                    return pi.output(x)
+                    remaining_ms = (
+                        None if req_deadline is None
+                        else max(0.0, (req_deadline - time.monotonic()))
+                        * 1e3)
+                    return pi.output(x, deadline_ms=remaining_ms)
                 except RequestValidationError:
                     raise  # the client's fault on ANY replica
+                except (DeadlineExceeded, RequestRejected):
+                    raise  # shed is shed — resubmitting amplifies load
                 except ReplicaUnavailable as e:
                     last = e
+                    resubmits += 1
+                    if resubmits > self.retry_budget:
+                        # booked as a shed, surfaced as one too: the
+                        # REST layer must answer 429 (retry later, the
+                        # work was never done), not a 500 that reads as
+                        # a genuine server failure
+                        self._pool_shed("retry_budget")
+                        raise RequestRejected(
+                            f"retry budget spent ({self.retry_budget} "
+                            f"resubmits)", reason="retry_budget",
+                            stage="resubmit") from last
                     self._m_rerouted.inc()
-            if time.monotonic() >= deadline:
+            now = time.monotonic()
+            if req_deadline is not None and now >= req_deadline:
+                self._pool_shed("expired")
+                raise DeadlineExceeded(
+                    "deadline expired during replica failover",
+                    stage="resubmit") from last
+            if now >= retry_by:
                 raise RuntimeError(
                     f"no healthy replica within {self.retry_window:.1f}s"
                 ) from last
@@ -865,6 +1293,26 @@ class ReplicaPool:
             "replica_evicted", replica=idx, generation=gen, reason=reason)
         logger.warning("replica %d evicted (gen %d): %s", idx, gen, reason)
         pi.abort(f"replica {idx} evicted: {reason}")
+        # abort() settled the replica's books (queued futures failed);
+        # fold its final counters into the retired ledger so its sheds
+        # and outcomes don't vanish from metrics() with the slot
+        try:
+            final = pi.metrics()
+        except Exception:
+            logger.exception("replica %d final metrics unreadable — its "
+                             "books drop from the JSON aggregate", idx)
+            final = None
+        if final is not None:
+            with self._lock:
+                r = self._retired
+                for k in ("requests", "examples", "batches", "oversized",
+                          "admitted", "completed", "shed", "failed",
+                          "rejected"):
+                    r[k] += final[k]
+                for sb, v in final["shed_by"].items():
+                    r["shed_by"][sb] = r["shed_by"].get(sb, 0) + v
+                for b, v in final["bucket_hits"].items():
+                    r["bucket_hits"][b] = r["bucket_hits"].get(b, 0) + v
         if not self.auto_heal or self._shutdown:
             return
         fresh = self._spawn(idx)
@@ -915,11 +1363,18 @@ class ReplicaPool:
     def metrics(self) -> dict:
         """Pool-aggregated serving counters in the ParallelInference
         schema (requests/examples/batches/bucket_hits summed over live
-        replicas), plus the pool's own lifecycle numbers and a
-        per-replica breakdown."""
+        replicas PLUS the retired books of evicted ones, so eviction
+        never erases history from the JSON aggregate), plus the pool's
+        own lifecycle numbers and a per-replica breakdown. `shed_by` mirrors serving_shed_total —
+        replica stages plus the pool's resubmit stage — while `shed`
+        stays the per-attempt conservation term (a resubmit shed's final
+        attempt is already booked `failed` on its replica)."""
         with self._lock:
             replicas = list(self._replicas)
             gens = list(self._gen)
+            pool_shed_by = dict(self._pool_shed_by)
+            retired = {k: (dict(v) if isinstance(v, dict) else v)
+                       for k, v in self._retired.items()}
         per, agg = [], None
         for idx, pi in enumerate(replicas):
             if pi is None:
@@ -934,8 +1389,12 @@ class ReplicaPool:
             if agg is None:
                 agg = m
             else:
-                for k in ("requests", "examples", "batches", "oversized"):
+                for k in ("requests", "examples", "batches", "oversized",
+                          "admitted", "completed", "shed", "failed",
+                          "rejected"):
                     agg[k] += m[k]
+                for sb, v in m["shed_by"].items():
+                    agg["shed_by"][sb] = agg["shed_by"].get(sb, 0) + v
                 for b, v in m["bucket_hits"].items():
                     agg["bucket_hits"][b] = agg["bucket_hits"].get(b, 0) + v
                 agg["queue_depth"] += m["queue_depth"]
@@ -945,10 +1404,21 @@ class ReplicaPool:
             agg = {"mode": self._pi_kwargs["inference_mode"], "requests": 0,
                    "examples": 0, "batches": 0, "oversized": 0,
                    "bucket_hits": {}, "buckets": [],
+                   "admitted": 0, "completed": 0, "shed": 0, "failed": 0,
+                   "rejected": 0, "shed_by": {},
                    "max_batch_size": self._pi_kwargs["max_batch_size"],
                    "batch_timeout_ms":
                        self._pi_kwargs["batch_timeout_ms"],
                    "queue_depth": 0, "forward_compiles": 0}
+        for k in ("requests", "examples", "batches", "oversized",
+                  "admitted", "completed", "shed", "failed", "rejected"):
+            agg[k] += retired[k]
+        for sb, v in retired["shed_by"].items():
+            agg["shed_by"][sb] = agg["shed_by"].get(sb, 0) + v
+        for b, v in retired["bucket_hits"].items():
+            agg["bucket_hits"][b] = agg["bucket_hits"].get(b, 0) + v
+        for sb, v in pool_shed_by.items():
+            agg["shed_by"][sb] = agg["shed_by"].get(sb, 0) + v
         agg["replicas"] = per
         agg["n_replicas"] = self.n_replicas
         agg["in_rotation"] = sum(1 for pi in replicas if pi is not None)

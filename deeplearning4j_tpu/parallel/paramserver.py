@@ -37,6 +37,7 @@ import json
 import logging
 import os
 import queue
+import random
 import struct
 import threading
 import time
@@ -46,6 +47,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from deeplearning4j_tpu.utils import faultpoints as _faults
 from deeplearning4j_tpu.utils import health as _health
 from deeplearning4j_tpu.utils import metrics as _metrics
 from deeplearning4j_tpu.utils.concurrency import (
@@ -380,6 +382,9 @@ class EmbeddingPSClient:
         self.max_retries = max(0, int(max_retries))
         self.retry_backoff = float(retry_backoff)
         self.replay_capacity = max(0, int(replay_capacity))
+        # per-client backoff jitter stream (de-correlates clients; needs
+        # no cross-run determinism — fault injection has its own RNGs)
+        self._jitter = random.Random()
         self.dropped_pushes = 0
         self._dims: Dict[str, int] = {}
         self._q: "queue.Queue" = queue.Queue(maxsize=queue_size)
@@ -425,18 +430,32 @@ class EmbeddingPSClient:
         t0 = time.perf_counter()
         try:  # count failures too (server side does the same): an outage
             # must show up in the RPC series, not just the drop counter
+            # chaos hook: an `error` fault is a dropped/refused RPC (the
+            # retry/replay machinery absorbs it); `latency` is a slow
+            # network; `hang` is the wedged-endpoint case the push
+            # drain's heartbeat exists for
+            _faults.fault_point("paramserver_rpc", route=label)
             with urllib.request.urlopen(req, timeout=self.timeout) as r:
                 return r.read()
         finally:
             self._m_rpc.labels(label).inc()
             self._m_rpc_sec.labels(label).observe(time.perf_counter() - t0)
 
-    def _post_with_retry(self, url: str, route: str,
-                         payload: bytes) -> bytes:
-        """`_post_bin` with bounded exponential backoff — a blip (server
-        restart, transient network fault) costs latency, not data. The
-        final failure propagates; push callers park the payload for
-        replay, pull callers surface it (the step needs the rows NOW)."""
+    def _post_with_retry(self, url: str, route: str, payload: bytes,
+                         deadline: Optional[float] = None) -> bytes:
+        """`_post_bin` with bounded, JITTERED exponential backoff — a
+        blip (server restart, transient network fault) costs latency,
+        not data. The final failure propagates; push callers park the
+        payload for replay, pull callers surface it (the step needs the
+        rows NOW).
+
+        Jitter (±50% per sleep, from a per-client RNG): pure exponential
+        backoff synchronizes — every client that failed in the same
+        server outage retries at the same instants and thundering-herds
+        the recovering endpoint; the spread de-correlates them. `deadline`
+        (time.monotonic seconds) caps the TOTAL retry spend: a caller
+        with a latency budget stops burning it on a dead endpoint — the
+        failure surfaces while the budget can still pay for a fallback."""
         label = route.lstrip("/")
         attempt = 0
         while True:
@@ -445,9 +464,18 @@ class EmbeddingPSClient:
             except Exception:
                 if attempt >= self.max_retries or self._stop.is_set():
                     raise
+                if deadline is not None \
+                        and time.monotonic() >= deadline:
+                    raise
+                sleep = (self.retry_backoff * (2 ** attempt)
+                         * self._jitter.uniform(0.5, 1.5))
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if sleep >= remaining:
+                        raise  # the wait alone would blow the budget
                 self._m_retries.labels(label).inc()
                 # stop-aware sleep: a close() mid-backoff aborts the wait
-                self._stop.wait(self.retry_backoff * (2 ** attempt))
+                self._stop.wait(sleep)
                 attempt += 1
 
     def _dim(self, table: str) -> int:
@@ -461,9 +489,14 @@ class EmbeddingPSClient:
                 self._dims[k] = int(shape[1])
         return self._dims[table]
 
-    def pull(self, table: str, rows: np.ndarray) -> np.ndarray:
+    def pull(self, table: str, rows: np.ndarray,
+             deadline_ms: Optional[float] = None) -> np.ndarray:
         """Fetch rows (grouped per owning shard, order restored). Empty
-        row sets return a well-formed [0, dim] array."""
+        row sets return a well-formed [0, dim] array. `deadline_ms`
+        caps the retry spend across every shard RPC: past it, the
+        failure propagates instead of backing off further."""
+        deadline = (None if deadline_ms is None
+                    else time.monotonic() + float(deadline_ms) / 1e3)
         rows = np.asarray(rows, np.int64)
         if rows.size == 0:
             return np.zeros((0, self._dim(table)), np.float32)
@@ -473,7 +506,8 @@ class EmbeddingPSClient:
             if sel.size == 0:
                 continue
             got = _unpack_rows(self._post_with_retry(
-                url, "/pull.bin", _pack_request(table, rows[sel])))
+                url, "/pull.bin", _pack_request(table, rows[sel]),
+                deadline=deadline))
             if out is None:
                 out = np.zeros((rows.size, got.shape[1]), np.float32)
             out[sel] = got

@@ -9,10 +9,15 @@ requests, pads each fused group to a fixed bucket so only ~log2(B)
 forward traces ever compile, and overlaps host batch assembly with
 device execution). Routes:
 
-    POST /predict  {"features": [[...], ...]}   -> {"predictions": [...]}
+    POST /predict  {"features": [[...], ...], "deadline_ms": 250}
+                                                -> {"predictions": [...]}
                    (a single flat example is also accepted and returns a
                     single prediction row; a multi-output graph returns
-                    one predictions entry per output head)
+                    one predictions entry per output head; `deadline_ms`
+                    — or an X-Deadline-Ms header — is the request's
+                    latency budget: work that cannot make it is SHED
+                    with 429 + Retry-After, never served late. 503 is
+                    reserved for /health degradation.)
     GET  /health   -> {"status": "ok", "model": ..., "feature_shape": ...}
     GET  /metrics  -> {"requests", "examples", "batches", "queue_depth",
                        "buckets", "bucket_hits", "oversized",
@@ -36,6 +41,7 @@ from __future__ import annotations
 import argparse
 import json
 import logging
+import math
 import time
 import urllib.parse
 from typing import Optional, Sequence
@@ -43,9 +49,11 @@ from typing import Optional, Sequence
 import numpy as np
 
 from deeplearning4j_tpu.parallel.inference import (
+    DeadlineExceeded,
     InferenceMode,
     ParallelInference,
     ReplicaPool,
+    RequestRejected,
     RequestValidationError,
 )
 from deeplearning4j_tpu.utils import health as _health
@@ -70,6 +78,9 @@ class InferenceServer:
         warmup_shape: Optional[Sequence[int]] = None,
         health_stall_after: float = 30.0,
         n_replicas: int = 1,
+        queue_capacity: int = 1024,
+        default_deadline_ms: Optional[float] = None,
+        request_timeout: float = 30.0,
     ):
         # n_replicas >= 2 turns on the self-healing pool: each replica's
         # collector/dispatcher heartbeats are watched separately, an
@@ -85,12 +96,16 @@ class InferenceServer:
                 max_batch_size=max_batch_size,
                 batch_timeout_ms=batch_timeout_ms, buckets=buckets,
                 health_stall_after=health_stall_after,
+                queue_capacity=queue_capacity,
+                default_deadline_ms=default_deadline_ms,
             )
         else:
             self.inference = ParallelInference(
                 model, mesh, inference_mode, max_batch_size,
                 batch_timeout_ms, buckets,
                 health_stall_after=health_stall_after,
+                queue_capacity=queue_capacity,
+                default_deadline_ms=default_deadline_ms,
             )
         if warmup_shape is not None:
             self.inference.warmup(warmup_shape)
@@ -101,7 +116,8 @@ class InferenceServer:
             "serving_request_seconds",
             "end-to-end /predict latency (admission to result)").labels()
         self._server = JsonHttpServer(get=self._get, post=self._post,
-                                      port=port)
+                                      port=port,
+                                      request_timeout=request_timeout)
 
     @property
     def port(self) -> int:
@@ -175,12 +191,48 @@ class InferenceServer:
         single = feats.ndim == 1
         if single:
             feats = feats[None]
+        # deadline: JSON field wins over the X-Deadline-Ms header; both
+        # are a RELATIVE budget in ms from arrival (clients with clock
+        # skew cannot express an absolute deadline honestly). Header
+        # names compare case-insensitively (RFC 9110) — an HTTP/2 proxy
+        # in front of this server lowercases them
+        deadline_ms = req.get("deadline_ms")
+        if deadline_ms is None:
+            deadline_ms = next(
+                (v for k, v in headers.items()
+                 if k.lower() == "x-deadline-ms"), None)
+        if deadline_ms is not None:
+            try:
+                deadline_ms = float(deadline_ms)
+            except (TypeError, ValueError):
+                return json_response(
+                    {"error": f"bad deadline_ms: {deadline_ms!r}"}, 400)
+            if not math.isfinite(deadline_ms):
+                # json.loads parses bare NaN/Infinity; a NaN budget makes
+                # every deadline comparison False — admitted, then shed
+                # with a misleading 429. Malformed input is a 400.
+                return json_response(
+                    {"error": f"deadline_ms must be finite, "
+                              f"got {deadline_ms!r}"}, 400)
         t0 = time.perf_counter()
         try:
             with _tracing.span("serve/predict", examples=int(feats.shape[0])):
-                out = self.inference.output(feats)
+                out = self.inference.output(feats, deadline_ms=deadline_ms)
         except RequestValidationError as e:  # the client's fault
             return json_response({"error": str(e)}, 400)
+        except (RequestRejected, DeadlineExceeded) as e:
+            # shed, not failed: 429 tells clients/load-balancers to back
+            # off and retry later (Retry-After carries the server's wait
+            # estimate); 503 stays reserved for GET /health degradation
+            retry_after = max(0.05, getattr(e, "retry_after", 0.0) or 0.05)
+            # the header must be integer delta-seconds (RFC 9110) or
+            # conforming clients drop it; the body keeps the precision
+            return json_response(
+                {"error": str(e), "shed": True,
+                 "stage": getattr(e, "stage", "admission"),
+                 "retry_after_ms": round(retry_after * 1e3, 1)},
+                429,
+                headers={"Retry-After": str(max(1, math.ceil(retry_after)))})
         except Exception as e:
             # anything else (shutdown race, model/XLA failure — including
             # server-side ValueErrors) is a server fault: 500, so
@@ -232,6 +284,16 @@ def main(argv=None):
     ap.add_argument("--replicas", type=int, default=1,
                     help=">=2 serves through a self-healing ReplicaPool: "
                          "unhealthy replicas are evicted and respawned")
+    ap.add_argument("--queueCapacity", type=int, default=1024,
+                    help="bounded request queue: admission returns 429 "
+                         "instead of queueing past this depth (0 = "
+                         "unbounded)")
+    ap.add_argument("--defaultDeadlineMs", type=float, default=None,
+                    help="latency budget applied to requests that carry "
+                         "no deadline_ms of their own")
+    ap.add_argument("--requestTimeout", type=float, default=30.0,
+                    help="per-connection socket read timeout (slowloris "
+                         "protection); 0 disables")
     args = ap.parse_args(argv)
     from deeplearning4j_tpu.cli import guess_and_load_model
 
@@ -244,6 +306,9 @@ def main(argv=None):
         model, port=args.port, max_batch_size=args.maxBatchSize,
         batch_timeout_ms=args.batchTimeoutMs, buckets=buckets,
         warmup_shape=warmup, n_replicas=args.replicas,
+        queue_capacity=args.queueCapacity,
+        default_deadline_ms=args.defaultDeadlineMs,
+        request_timeout=args.requestTimeout,
     )
     # operator surface: opt in to real log output, then announce through
     # the package logger (library code never prints — lint CC006)

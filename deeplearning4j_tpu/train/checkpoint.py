@@ -39,6 +39,7 @@ from typing import List, Optional, Tuple
 
 from deeplearning4j_tpu.train.listeners import IterationListener
 from deeplearning4j_tpu.utils import blackbox as _blackbox
+from deeplearning4j_tpu.utils import faultpoints as _faults
 from deeplearning4j_tpu.utils import health as _health
 from deeplearning4j_tpu.utils import metrics as _metrics
 from deeplearning4j_tpu.utils import sigchain as _sigchain
@@ -289,6 +290,14 @@ class CheckpointListener(IterationListener):
         t0 = time.perf_counter()
         with self._io_lock:
             with _tracing.span("checkpoint/write", reason=reason):
+                # chaos hook: an `error` fault before the write is a
+                # full-disk / dead-volume save failure; landing before
+                # snap.write means no tmp file is ever created, and one
+                # BETWEEN write and replace would be the torn-file case
+                # the atomic rename makes survivable (the .tmp is
+                # swept by _gc, latest.json still names the previous
+                # good checkpoint)
+                _faults.fault_point("ckpt_write", reason=reason)
                 snap.write(tmp)
                 os.replace(tmp, path)  # atomic: never a torn checkpoint
             meta = {

@@ -342,11 +342,14 @@ class RemoteUIStatsStorageRouter(StatsStorageRouter):
                     pass
 
     def flush(self, timeout: float = 10.0):
-        """Block until queued records are posted (tests / end of run)."""
+        """Block until queued records are posted (tests / end of run).
+        Waits on unfinished_tasks, not empty(): the final record leaves
+        the queue BEFORE its POST completes, and flush returning inside
+        that window hands the caller a storage missing it."""
         import time as _time
 
         deadline = _time.monotonic() + timeout
-        while not self._q.empty() and _time.monotonic() < deadline:
+        while self._q.unfinished_tasks and _time.monotonic() < deadline:
             _time.sleep(0.02)
 
     def put_static_info(self, session_id, info):

@@ -1,19 +1,34 @@
 """Tiny shared HTTP scaffolding for the framework's servers (k-NN
 serving, training UI, embedding parameter server, Keras-backend entry
 point). One place for the Content-Length / parse / respond / error
-boilerplate the four servers would otherwise each re-implement."""
+boilerplate the four servers would otherwise each re-implement.
+
+Robustness contract: every socket read on a handler thread carries a
+per-connection timeout (`request_timeout`, default 30s). Without it a
+single slowloris client — open the connection, send headers, then
+trickle or stall the body — pins one `dl4j-http-*` thread forever and,
+repeated, starves the ThreadingHTTPServer. Timed-out connections are
+dropped (no response: the peer is by definition not reading) and
+counted under `http_request_timeout_total`.
+"""
 
 from __future__ import annotations
 
 import json
 import math
+import socket
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional, Tuple
 
+from deeplearning4j_tpu.utils import faultpoints as _faults
+from deeplearning4j_tpu.utils import metrics as _metrics
+
 # handler contract: fn(path, body_bytes, headers) ->
-#   (status, content_type, payload_bytes) or None for "no such route"
-Handler = Callable[[str, bytes, dict], Optional[Tuple[int, str, bytes]]]
+#   (status, content_type, payload_bytes)            or
+#   (status, content_type, payload_bytes, extra_headers_dict)  or
+#   None for "no such route"
+Handler = Callable[[str, bytes, dict], Optional[Tuple]]
 
 
 def _finite(obj):
@@ -31,7 +46,8 @@ def _finite(obj):
     return obj
 
 
-def json_response(obj, code: int = 200) -> Tuple[int, str, bytes]:
+def json_response(obj, code: int = 200,
+                  headers: Optional[dict] = None) -> Tuple:
     # common case (all-finite payloads, e.g. large /predict bodies) stays
     # on the C-speed serializer; only a non-finite payload pays the
     # Python-level _finite walk
@@ -39,6 +55,8 @@ def json_response(obj, code: int = 200) -> Tuple[int, str, bytes]:
         payload = json.dumps(obj, allow_nan=False)
     except ValueError:
         payload = json.dumps(_finite(obj), allow_nan=False)
+    if headers:
+        return code, "application/json", payload.encode(), dict(headers)
     return code, "application/json", payload.encode()
 
 
@@ -54,35 +72,76 @@ class JsonHttpServer:
     dashboard/serving process)."""
 
     def __init__(self, *, get: Optional[Handler] = None,
-                 post: Optional[Handler] = None, port: int = 0):
+                 post: Optional[Handler] = None, port: int = 0,
+                 request_timeout: float = 30.0):
         self._get = get
         self._post = post
         self.port = int(port)
+        # <= 0 means "no timeout" (the repo-wide 0-disables convention);
+        # passing 0.0 through would make socketserver settimeout(0.0)
+        # the connection NON-BLOCKING and drop every request
+        self.request_timeout = (None if request_timeout is None
+                                or float(request_timeout) <= 0
+                                else float(request_timeout))
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+        self._m_timeouts = _metrics.get_registry().counter(
+            "http_request_timeout_total",
+            "connections dropped because a read exceeded the "
+            "per-connection timeout (slowloris protection)").labels()
 
     def start(self) -> int:
         outer = self
 
         class _H(BaseHTTPRequestHandler):
+            # socketserver.StreamRequestHandler.setup() applies this to
+            # the connection: EVERY read (request line, headers, body)
+            # has a deadline — one stalled client cannot pin the thread
+            timeout = outer.request_timeout
+
             def log_message(self, *a):
                 pass
 
+            def log_error(self, fmt, *a):
+                # the base handler's request-line/header timeout path
+                # ("Request timed out: ...") reports only through
+                # log_error — hook it so those drops are counted too
+                if "timed out" in fmt:
+                    outer._m_timeouts.inc()
+
             def _dispatch(self, handler: Optional[Handler]):
-                n = int(self.headers.get("Content-Length", 0))
-                body = self.rfile.read(n) if n else b""
                 try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    body = self.rfile.read(n) if n else b""
+                except (socket.timeout, TimeoutError):
+                    # slowloris body: drop the connection without a
+                    # response — the peer is, by definition, not reading
+                    outer._m_timeouts.inc()
+                    self.close_connection = True
+                    return
+                try:
+                    # chaos hook: an `error` fault here is a handler
+                    # crash (500, connection survives); a `latency`/
+                    # `hang` is a stalled handler thread
+                    _faults.fault_point("http_handler", path=self.path)
                     out = handler(self.path, body, dict(self.headers)) \
                         if handler else None
                     if out is None:
                         out = json_response({"error": "not found"}, 404)
+                except _faults.FaultInjected as e:
+                    out = json_response(
+                        {"error": f"{type(e).__name__}: {e}"}, 500)
                 except Exception as e:  # keep serving
                     out = json_response(
                         {"error": f"{type(e).__name__}: {e}"}, 400)
-                code, ctype, payload = out
+                code, ctype, payload = out[:3]
+                extra = out[3] if len(out) > 3 else None
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(payload)))
+                if extra:
+                    for k, v in extra.items():
+                        self.send_header(k, str(v))
                 self.end_headers()
                 self.wfile.write(payload)
 

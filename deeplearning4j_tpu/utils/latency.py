@@ -37,6 +37,14 @@ class LatencyTracker:
         with self._lock:
             return self._count
 
+    def percentile_seconds(self, q: float):
+        """Nearest-rank percentile over the window, in seconds (None
+        until something was recorded). The admission-control estimate in
+        parallel/inference reads rolling batch latency through this."""
+        with self._lock:
+            vals = sorted(self._window)
+        return percentile(vals, q) if vals else None
+
     def snapshot(self) -> dict:
         """{"count", "mean_ms", "p50_ms", "p99_ms"} over the window
         (count/mean are all-time)."""

@@ -288,8 +288,14 @@ def test_donation_check():
 _BAD_MODULE = textwrap.dedent("""\
     import queue
     import threading
+    import time
 
     q = queue.Queue(maxsize=2)
+
+
+    def wait_until(timeout):
+        deadline = time.time() + timeout
+        return deadline
 
 
     def worker():
@@ -334,12 +340,14 @@ def bad_module(tmp_path):
 def test_lint_flags_every_code_once(bad_module):
     fs = lint_paths([str(bad_module)], base_dir=str(bad_module.parent))
     got = sorted(set(codes(fs)))
-    assert got == ["CC001", "CC002", "CC003", "CC004", "CC005", "CC006"]
+    assert got == ["CC001", "CC002", "CC003", "CC004", "CC005", "CC006",
+                   "CC007"]
     # stable names: scope-qualified, no line numbers
     names = {f.name for f in fs}
     assert "CC001:badmod.py:worker" in names
     assert "CC002:badmod.py:start" in names  # the timeout-less q.put(1)
     assert any(n.startswith("CC005:") for n in names)
+    assert "CC007:badmod.py:wait_until" in names
 
 
 def test_lint_accepts_the_sanctioned_shapes(tmp_path):
@@ -375,6 +383,35 @@ def test_lint_accepts_the_sanctioned_shapes(tmp_path):
             q.put(3, block=False)  # cannot wedge: raises Full immediately
         """))
     assert lint_paths([str(p)], base_dir=str(tmp_path)) == []
+
+
+def test_lint_cc007_walltime_deadlines(tmp_path):
+    """CC007 fires only on wall-clock DEADLINE arithmetic: monotonic
+    deadlines and plain timestamping both stay legal."""
+    p = tmp_path / "clocks.py"
+    p.write_text(textwrap.dedent("""\
+        import time
+
+
+        def legal(budget):
+            deadline = time.monotonic() + budget  # sanctioned clock
+            meta = {"ts": time.time()}            # timestamping
+            wall = time.time()                    # no deadline words
+            return deadline, meta, wall
+
+
+        def bad_expiry():
+            expires_at = time.time() + 60.0
+            return expires_at
+
+
+        def bad_poll(timeout):
+            while time.time() < timeout:
+                pass
+        """))
+    fs = lint_paths([str(p)], base_dir=str(tmp_path))
+    assert sorted(f.name for f in fs) == [
+        "CC007:clocks.py:bad_expiry", "CC007:clocks.py:bad_poll"]
 
 
 def test_lint_str_join_does_not_mask_cc004(tmp_path):
