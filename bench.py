@@ -36,10 +36,8 @@ import numpy as np
 from deeplearning4j_tpu.data.dataset import DataSet
 from deeplearning4j_tpu.data.iterators import ExistingDataSetIterator
 from deeplearning4j_tpu.utils.flops import (
-    graph_forward_flops,
-    mln_forward_flops,
     peak_flops_per_chip,
-    train_step_flops,
+    train_step_flops_for,
 )
 
 
@@ -56,6 +54,21 @@ def _device_dataset(x, y) -> DataSet:
     import jax
 
     return DataSet(jax.device_put(x), jax.device_put(y))
+
+
+def _step_flops(net_factory, batch, timesteps: int = 16):
+    """Model FLOPs of one optimizer step for a workload's MFU, sourced
+    from the jaxpr cost model of the REAL step program (helpers
+    disabled during the trace — model FLOPs are implementation-
+    independent), falling back to the analytic per-layer estimate.
+    Returns (flops_per_step, source); the source is recorded next to
+    every MFU so a FLOP-accounting change can never masquerade as a
+    speedup (the vs_baseline drift check reads it)."""
+    net = net_factory()
+    try:
+        return train_step_flops_for(net, batch, timesteps=timesteps)
+    finally:
+        del net  # free the throwaway params before the timed runs
 
 
 def _doctor_refusal(conf, unit):
@@ -192,11 +205,16 @@ def bench_resnet50(batch=128, steps=8, image_size=224, classes=1000):
     rng = np.random.default_rng(0)
     x = rng.random((batch, image_size, image_size, 3), np.float32)
     ds = _device_dataset(x, _onehot(rng, batch, classes))
+    step_flops, flops_source = _step_flops(
+        lambda: ComputationGraph(conf).init(), batch)
 
     def run(helpers_on):
         for op in ("conv2d", "batch_norm"):
             set_helper_enabled(op, helpers_on)
         net = ComputationGraph(conf).init()  # fresh net => fresh trace
+        if step_flops:  # devprof's live MFU gauges ride the same model
+            net.set_model_flops_per_example(step_flops / batch,
+                                            flops_source)
         dt, n_steps = _time_fit(
             net, lambda k: ExistingDataSetIterator([ds] * k), steps,
             reps=3 if on_tpu else 1)
@@ -216,9 +234,8 @@ def bench_resnet50(batch=128, steps=8, image_size=224, classes=1000):
         raise RuntimeError(f"both conv/BN paths failed: {errors}")
     kernel = max(results, key=lambda k: results[k][0])
     ips, dt, n_steps = results[kernel]
-    fwd = graph_forward_flops(conf)
-    step_flops = train_step_flops(fwd, batch)
-    mfu = (step_flops * n_steps / dt) / peak_flops_per_chip() if on_tpu else None
+    mfu = ((step_flops * n_steps / dt) / peak_flops_per_chip()
+           if on_tpu and step_flops else None)
     alternates = {k: round(v[0], 2) for k, v in results.items() if k != kernel}
     return {
         "value": round(ips, 2),
@@ -226,6 +243,7 @@ def bench_resnet50(batch=128, steps=8, image_size=224, classes=1000):
         "batch": batch,
         "steps": steps,
         "image_size": image_size,
+        "classes": classes,
         # fit(async_prefetch=True) routes through the staged input
         # pipeline: batches flow via DevicePrefetchIterator (the protocol
         # still pre-stages them in HBM, so the device_put the prefetch
@@ -236,6 +254,7 @@ def bench_resnet50(batch=128, steps=8, image_size=224, classes=1000):
         **({"kernel_errors": errors} if errors else {}),
         "seconds": round(dt, 3),
         "model_flops_per_step": step_flops,
+        "flops_source": flops_source,
         "mfu": None if mfu is None else round(mfu, 4),
     }
 
@@ -247,15 +266,17 @@ def bench_lenet(batch=512, steps=30):
     on_tpu = jax.default_backend() not in ("cpu",)
     conf = lenet_conf(precision="bf16" if on_tpu else "f32")
     net = MultiLayerNetwork(conf).init().set_fused_steps(10)
+    step_flops, flops_source = train_step_flops_for(net, batch)
+    if step_flops:
+        net.set_model_flops_per_example(step_flops / batch, flops_source)
     rng = np.random.default_rng(0)
     ds = _device_dataset(rng.random((batch, 784), np.float32),
                          _onehot(rng, batch, 10))
     dt, n_steps = _time_fit(net, lambda k: ExistingDataSetIterator([ds] * k), steps,
                             reps=3 if on_tpu else 1)
     ips = batch * n_steps / dt
-    fwd = mln_forward_flops(conf)
-    step_flops = train_step_flops(fwd, batch)
-    mfu = (step_flops * n_steps / dt) / peak_flops_per_chip() if on_tpu else None
+    mfu = ((step_flops * n_steps / dt) / peak_flops_per_chip()
+           if on_tpu and step_flops else None)
     return {
         "value": round(ips, 1),
         "unit": "images/sec/chip",
@@ -263,6 +284,7 @@ def bench_lenet(batch=512, steps=30):
         "steps": steps,
         "seconds": round(dt, 3),
         "model_flops_per_step": step_flops,
+        "flops_source": flops_source,
         "mfu": None if mfu is None else round(mfu, 4),
     }
 
@@ -295,12 +317,16 @@ def bench_char_lstm(batch=64, seq_len=200, tbptt=50, vocab=77, hidden=200,
     y = np.eye(vocab, dtype=np.float32)[yidx]
     ds = _device_dataset(x, y)
     segments = -(-seq_len // tbptt)
-    refusal = _doctor_refusal(
-        char_lstm_conf(vocab_size=vocab, hidden=hidden, tbptt_length=tbptt,
-                       precision="bf16" if on_tpu else "f32"),
-        "tokens/sec/chip")
+    conf0 = char_lstm_conf(vocab_size=vocab, hidden=hidden,
+                           tbptt_length=tbptt,
+                           precision="bf16" if on_tpu else "f32")
+    refusal = _doctor_refusal(conf0, "tokens/sec/chip")
     if refusal is not None:
         return refusal
+    # full-sequence step FLOPs (the TBPTT segmentation splits the same
+    # matmuls across dispatches; it does not change their count)
+    step_flops, flops_source = _step_flops(
+        lambda: MultiLayerNetwork(conf0).init(), batch, timesteps=seq_len)
 
     def run(kernel_on):
         set_helper_enabled("lstm_sequence", kernel_on)
@@ -308,6 +334,9 @@ def bench_char_lstm(batch=64, seq_len=200, tbptt=50, vocab=77, hidden=200,
                               tbptt_length=tbptt,
                               precision="bf16" if on_tpu else "f32")
         net = MultiLayerNetwork(conf).init().set_fused_steps(fused)
+        if step_flops:
+            net.set_model_flops_per_example(step_flops / batch,
+                                            flops_source)
         dt, n_steps = _time_fit(
             net, lambda k: ExistingDataSetIterator([ds] * k), steps,
             reps=reps)
@@ -324,9 +353,8 @@ def bench_char_lstm(batch=64, seq_len=200, tbptt=50, vocab=77, hidden=200,
         raise RuntimeError(f"both kernels failed: {errors}")
     kernel = max(results, key=lambda k: results[k][1])
     conf, tokens, dt, fit_batches = results[kernel]
-    fwd = mln_forward_flops(conf)  # per example, per timestep (no ts set)
-    tf = train_step_flops(fwd * seq_len, batch) * fit_batches / dt
-    mfu = tf / peak_flops_per_chip() if on_tpu else None
+    mfu = (step_flops * fit_batches / dt / peak_flops_per_chip()
+           if on_tpu and step_flops else None)
     alternates = {k: round(v[1], 1) for k, v in results.items()
                   if k != kernel}
     return {
@@ -335,11 +363,14 @@ def bench_char_lstm(batch=64, seq_len=200, tbptt=50, vocab=77, hidden=200,
         "batch": batch,
         "seq_len": seq_len,
         "tbptt": tbptt,
+        "vocab": vocab,
         "hidden": hidden,
         "kernel": kernel,
         "vs_alternate": alternates,
         **({"kernel_errors": errors} if errors else {}),
         "seconds": round(dt, 3),
+        "model_flops_per_step": step_flops,
+        "flops_source": flops_source,
         "mfu": None if mfu is None else round(mfu, 4),
         # what "good" is: cuDNN-era fused LSTM training lands ~5-15% MFU
         # at these small-cell shapes; the round-2 scan path measured 0.007
@@ -360,21 +391,25 @@ def bench_vgg16(batch=32, steps=6, image_size=224, classes=1000):
     conf = vgg16_conf(num_classes=classes, image_size=image_size,
                       precision="bf16" if on_tpu else "f32")
     net = MultiLayerNetwork(conf).init().set_fused_steps(3)
+    step_flops, flops_source = train_step_flops_for(net, batch)
+    if step_flops:
+        net.set_model_flops_per_example(step_flops / batch, flops_source)
     rng = np.random.default_rng(0)
     x = rng.random((batch, image_size, image_size, 3), np.float32)
     ds = _device_dataset(x, _onehot(rng, batch, classes))
     dt, n_steps = _time_fit(net, lambda k: ExistingDataSetIterator([ds] * k), steps,
                             reps=3 if on_tpu else 1)
     ips = batch * n_steps / dt
-    fwd = mln_forward_flops(conf)
-    step_flops = train_step_flops(fwd, batch)
-    mfu = (step_flops * n_steps / dt) / peak_flops_per_chip() if on_tpu else None
+    mfu = ((step_flops * n_steps / dt) / peak_flops_per_chip()
+           if on_tpu and step_flops else None)
     return {
         "value": round(ips, 2),
         "unit": "images/sec/chip",
         "batch": batch,
         "image_size": image_size,
         "seconds": round(dt, 3),
+        "model_flops_per_step": step_flops,
+        "flops_source": flops_source,
         "mfu": None if mfu is None else round(mfu, 4),
     }
 
@@ -952,16 +987,39 @@ def _vs_baseline(workloads, backend):
                 "note": f"backend mismatch ({backend} vs prior "
                         f"{prior_backend}): ratios omitted"}
     ratios = {}
+    flop_drift = {}
     for name, out in workloads.items():
-        pv = ((prior.get("workloads") or {}).get(name) or {}).get("value")
+        prior_wl = (prior.get("workloads") or {}).get(name) or {}
+        pv = prior_wl.get("value")
         cv = out.get("value")
         if pv and cv:
             ratios[name] = round(cv / pv, 3)
-    return {
+        # FLOP-model drift (non-fatal warning): a speedup ratio is only
+        # meaningful when both rounds agree on what a step COSTS — an
+        # MFU "improvement" caused by a FLOP-accounting change must
+        # surface as accounting, never as performance
+        pf = prior_wl.get("model_flops_per_step")
+        cf = out.get("model_flops_per_step")
+        if pf and cf and abs(cf / pf - 1.0) > 0.01:
+            flop_drift[name] = {
+                "prior": pf,
+                "current": cf,
+                "ratio": round(cf / pf, 4),
+                "prior_source": prior_wl.get("flops_source", "analytic"),
+                "current_source": out.get("flops_source"),
+            }
+    result = {
         "source": prior_name,
         "headline": ratios.get("resnet50"),
         "speedup": ratios,
     }
+    if flop_drift:
+        result["flop_model_changed"] = flop_drift
+        result["flop_model_note"] = (
+            "model_flops_per_step differs from the prior round for these "
+            "workloads — their MFU numbers are not comparable across "
+            "rounds until the accounting change is acknowledged")
+    return result
 
 
 def _probe():

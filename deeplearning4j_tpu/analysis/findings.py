@@ -29,6 +29,12 @@ jaxpr audit (abstract trace of the train-step loss):
   JX006  train-step buffers not donated on a device backend (peak
          memory doubles)
 
+cost model (analysis/costmodel — static device cost of the train step):
+  JX007  cost model diverges from XLA cost_analysis beyond tolerance
+         (MFU/roofline numbers built on it are untrustworthy)
+  JX008  static residency estimate (params + updater + data +
+         activation liveness peak) exceeds device HBM — will OOM
+
 concurrency lint (AST over the repo itself):
   CC001  bare `except:`
   CC002  queue put/get without timeout/abort in thread code
@@ -36,6 +42,7 @@ concurrency lint (AST over the repo itself):
   CC004  thread neither daemon nor joined
   CC005  lock-order cycle across nested `with <lock>:` scopes
   CC006  stray print() in library code (use the package logger)
+  CC007  time.time() in deadline/timeout arithmetic (use monotonic)
 """
 
 from __future__ import annotations

@@ -231,17 +231,37 @@ def cmd_profile(args) -> int:
     """Aggregate a captured jax-profiler trace directory into the op-family
     device-time breakdown used by the PROFILE_*.md tables; --json exports
     it as a machine-readable artifact so bench runs attach breakdowns
-    mechanically instead of by hand (utils/profiler.py)."""
+    mechanically instead of by hand (utils/profiler.py). With --preset
+    the static cost model (analysis/costmodel) rides along: per-family
+    flops/bytes columns and roofline context next to the measured times."""
     from deeplearning4j_tpu.utils.profiler import (
         family_summary,
         format_summary,
         op_summary,
+        roofline_columns,
         write_profile_json,
     )
 
+    cost_model = None
+    if args.preset:
+        from deeplearning4j_tpu.analysis.costmodel import train_step_cost
+        from deeplearning4j_tpu.utils.flops import _helpers_disabled
+
+        net = _preset_network(args)
+        with _helpers_disabled():
+            cost_model = train_step_cost(
+                net, batch_size=args.batch,
+                timesteps=args.timesteps).to_dict()
+        # the static columns are only comparable to the measured trace
+        # when the dims match what the trace ran — say what was modeled
+        print(f"static cost model: {args.preset} train step at batch "
+              f"{args.batch} (set --batch to the batch the trace "
+              f"actually ran, or the flops/bytes columns will not match "
+              f"the measured ms)")
     if args.json:  # single parse — the xplane decode dominates runtime
         payload = write_profile_json(args.log_dir, args.json,
-                                     top_ops=args.top)
+                                     top_ops=args.top,
+                                     cost_model=cost_model)
         if not payload["families_ms"]:
             print(f"no device ops found in {args.log_dir} (missing trace "
                   f"or xplane proto unavailable)", file=sys.stderr)
@@ -252,11 +272,227 @@ def cmd_profile(args) -> int:
     if not rows:
         print(f"no device ops found in {args.log_dir} (missing trace or "
               f"xplane proto unavailable)", file=sys.stderr)
+    fams = dict(family_summary(rows))
+    annotated = roofline_columns(
+        {k: round(v * 1e3, 3) for k, v in fams.items()}, cost_model)
     print("device time by op family:")
-    for fam, sec in family_summary(rows)[:args.top]:
-        print(f"  {sec * 1e3:9.3f} ms  {fam}")
+    for fam, sec in sorted(fams.items(), key=lambda kv: -kv[1])[:args.top]:
+        row = annotated.get(fam) or {}
+        extra = ""
+        if row.get("flops") is not None:
+            extra = (f"  [{row['flops'] / 1e9:8.3f} GFLOP "
+                     f"{row['bytes'] / 2**20:8.1f} MiB moved]")
+        print(f"  {sec * 1e3:9.3f} ms  {fam}{extra}")
     print(format_summary(rows[:args.top]))
+    if cost_model:
+        print(f"\nstatic cost model (per step at batch "
+              f"{cost_model.get('batch')}, cost-model families):")
+        for name, fc in sorted(cost_model["families"].items(),
+                               key=lambda kv: -kv[1]["flops"])[:args.top]:
+            print(f"  {fc['flops'] / 1e9:10.4f} GFLOP "
+                  f"{fc['bytes'] / 2**20:9.1f} MiB  {name}")
     return 0
+
+
+def cmd_perf(args) -> int:
+    """Static device cost model of a preset's train step
+    (analysis/costmodel): per-primitive-family FLOPs, bytes moved and
+    compute- vs memory-bound roofline verdicts, the liveness-based
+    activation-peak and residency estimates, an optional XLA
+    cost_analysis cross-check (--xla — a real compile; findings JX007 on
+    divergence, JX008 on HBM overflow), and a FLOP-drift check against
+    the newest committed BENCH_r*.json so accounting changes surface as
+    accounting. Exit 1 on ERROR-severity findings."""
+    import json as _json
+
+    from deeplearning4j_tpu.analysis import costmodel
+    from deeplearning4j_tpu.analysis.findings import (
+        format_findings,
+        has_errors,
+    )
+    from deeplearning4j_tpu.utils.flops import _helpers_disabled
+
+    net = _preset_network(args)
+    with _helpers_disabled():
+        cm, xla_stats, findings = costmodel.check_network(
+            net, batch_size=args.batch, timesteps=args.timesteps,
+            tolerance=args.tolerance, compile_xla=args.xla)
+    roof = cm.roofline()
+    rows = cm.table()
+    vs_prior = None if args.no_vs_prior else _perf_vs_prior(args.preset)
+    if args.json:
+        payload = {
+            "preset": args.preset,
+            "batch": args.batch,
+            "cost_model": cm.to_dict(),
+            "roofline": roof,
+            "families": rows,
+            "xla": xla_stats,
+            "vs_prior": vs_prior,
+            "findings": [f.to_dict() for f in findings],
+        }
+        if args.json == "-":
+            print(_json.dumps(payload, indent=2, default=str))
+        else:
+            with open(args.json, "w") as f:
+                _json.dump(payload, f, indent=2, default=str)
+            print(f"wrote {args.json}")
+        return 1 if has_errors(findings) else 0
+
+    print(f"cost model — {args.preset} train step (batch {args.batch})")
+    print(f"  model FLOPs (MXU): {cm.model_flops:.4g}   "
+          f"total FLOPs: {cm.flops_total:.4g}   "
+          f"bytes moved: {cm.bytes_total:.4g}")
+    print(f"  activation peak (liveness est): "
+          f"{cm.activation_peak_bytes / 2**20:.2f} MiB   "
+          f"resident: {cm.resident_bytes / 2**20:.2f} MiB "
+          f"(params {cm.param_bytes / 2**20:.2f} + updater "
+          f"{cm.updater_bytes / 2**20:.2f} + data "
+          f"{cm.data_bytes / 2**20:.2f} + activations)")
+    print(f"  roofline @ {roof['peak_flops'] / 1e12:.0f} TFLOP/s, "
+          f"{roof['hbm_bandwidth'] / 1e9:.0f} GB/s "
+          f"(ridge {roof['ridge_intensity']:.0f} FLOP/B): "
+          f"step >= {roof['step_time_lower_bound_seconds'] * 1e3:.3f} ms "
+          f"({roof['bound']}-bound), MFU ceiling "
+          f"{roof['mfu_ceiling']:.3f}")
+    print(f"  {'family':<28} {'calls':>6} {'GFLOPs':>10} {'MiB':>9} "
+          f"{'FLOP/B':>8}  verdict")
+    for row in rows[:args.top]:
+        print(f"  {row['family']:<28} {row['count']:>6} "
+              f"{row['flops'] / 1e9:>10.4f} {row['bytes'] / 2**20:>9.1f} "
+              f"{row['intensity']:>8.2f}  {row['verdict']}"
+              + ("  (MXU)" if row["mxu"] else ""))
+    if args.xla:
+        if xla_stats:
+            rel = (cm.xla_comparable_flops - xla_stats["flops"]) \
+                / xla_stats["flops"]
+            print(f"  XLA cross-check: model {cm.xla_comparable_flops:.4g} "
+                  f"vs cost_analysis {xla_stats['flops']:.4g} "
+                  f"({rel:+.1%}, tolerance {args.tolerance:.0%})")
+        else:
+            print("  XLA cross-check: cost_analysis unavailable on this "
+                  "backend (skipped)")
+    if vs_prior:
+        note = vs_prior.get("note")
+        if note:
+            print(f"  vs prior: {note}")
+        else:
+            print(f"  vs {vs_prior['source']} {vs_prior['workload']}: "
+                  f"prior {vs_prior['prior_model_flops_per_step']:.4g} "
+                  f"({vs_prior['prior_flops_source']}) vs cost model "
+                  f"{vs_prior['costmodel_flops_per_step']:.4g} -> ratio "
+                  f"{vs_prior['ratio']}"
+                  + ("  ** FLOP accounting drifted — MFU not comparable "
+                     "across rounds **" if vs_prior["drifted"] else ""))
+    if findings:
+        print(format_findings(findings))
+    return 1 if has_errors(findings) else 0
+
+
+def _newest_bench(bench_dir: str = None):
+    """Newest committed BENCH_r*.json — same contract as
+    bench._prior_bench, reimplemented here so the CLI works without the
+    repo-root bench.py on sys.path. Returns (basename, result-with-
+    workloads) or (None, None)."""
+    import glob
+    import json as _json
+    import os
+    import re
+
+    if bench_dir is None:
+        bench_dir = os.path.dirname(os.path.dirname(os.path.abspath(
+            __file__)))
+
+    def round_no(p):
+        m = re.search(r"BENCH_r(\d+)\.json$", p)
+        return int(m.group(1)) if m else -1
+
+    for path in sorted(glob.glob(os.path.join(bench_dir, "BENCH_r*.json")),
+                       key=round_no, reverse=True):
+        try:
+            with open(path) as f:
+                doc = _json.load(f)
+        except (OSError, _json.JSONDecodeError):
+            continue
+        if not isinstance(doc, dict):
+            continue
+        if "workloads" in doc:
+            return os.path.basename(path), doc
+        for line in reversed(str(doc.get("tail", "")).strip().splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    result = _json.loads(line)
+                except _json.JSONDecodeError:
+                    continue
+                if "workloads" in result:
+                    return os.path.basename(path), result
+    return None, None
+
+
+def _perf_vs_prior(preset: str) -> dict:
+    """FLOP-drift check vs the newest committed bench round: recompute
+    the static model at the PRIOR round's workload dims (not the dims
+    of the current invocation) and compare with the
+    model_flops_per_step it recorded — a reported (never fatal)
+    verdict, so a FLOP-accounting change shows up as accounting."""
+    wl_name = {"resnet50": "resnet50", "charlstm": "char_lstm"}.get(preset)
+    if wl_name is None:
+        return None
+    prior_name, prior = _newest_bench()
+    if not prior:
+        return None
+    wl = (prior.get("workloads") or {}).get(wl_name) or {}
+    pf, batch = wl.get("model_flops_per_step"), wl.get("batch")
+    if not pf or not batch:
+        return {"source": prior_name,
+                "note": f"prior {wl_name} has no model_flops_per_step"}
+    from deeplearning4j_tpu.analysis.costmodel import train_step_cost
+    from deeplearning4j_tpu.utils.flops import _helpers_disabled
+
+    try:
+        with _helpers_disabled():
+            if preset == "resnet50":
+                from deeplearning4j_tpu.models.resnet import resnet50_network
+
+                img = int(wl.get("image_size") or 224)
+                # `classes` is recorded from PR 9 on; older committed
+                # rounds fall back to the config convention (CPU smoke
+                # ran 10 classes at small images, TPU the 1000-way head)
+                classes = int(wl.get("classes")
+                              or (1000 if img >= 224 else 10))
+                net = resnet50_network(num_classes=classes,
+                                       image_size=img)
+                prior_cm = train_step_cost(net, batch_size=int(batch))
+            else:
+                from deeplearning4j_tpu.models.charlstm import (
+                    char_lstm_network,
+                )
+
+                # `vocab` is recorded from PR 9 on; older rounds ran
+                # the default 77-symbol charset
+                net = char_lstm_network(
+                    vocab_size=int(wl.get("vocab") or 77),
+                    hidden=int(wl.get("hidden") or 200),
+                    tbptt_length=int(wl.get("tbptt") or 50))
+                prior_cm = train_step_cost(
+                    net, batch_size=int(batch),
+                    timesteps=int(wl.get("seq_len") or 200))
+    except Exception as e:
+        return {"source": prior_name,
+                "note": f"recompute at prior dims failed: "
+                        f"{type(e).__name__}: {e}"}
+    cur = prior_cm.model_flops
+    ratio = cur / pf
+    return {
+        "source": prior_name,
+        "workload": wl_name,
+        "prior_model_flops_per_step": pf,
+        "prior_flops_source": wl.get("flops_source", "analytic"),
+        "costmodel_flops_per_step": cur,
+        "ratio": round(ratio, 4),
+        "drifted": abs(ratio - 1.0) > 0.01,
+    }
 
 
 def cmd_metrics(args) -> int:
@@ -850,7 +1086,51 @@ def main(argv=None) -> int:
     p.add_argument("--json", default=None,
                    help="write the aggregation to this path as JSON")
     p.add_argument("--top", type=int, default=40)
+    p.add_argument("--preset", default=None,
+                   help="attach the static cost model of this preset's "
+                        "train step (resnet50|tiny_resnet|charlstm): "
+                        "per-family flops/bytes columns + roofline "
+                        "context next to the measured times")
+    p.add_argument("--batch", type=int, default=8,
+                   help="cost-model batch size (--preset)")
+    p.add_argument("--timesteps", type=int, default=16,
+                   help="cost-model sequence length for recurrent "
+                        "presets (--preset)")
+    p.add_argument("--image-size", type=int, default=None)
+    p.add_argument("--classes", type=int, default=None)
     p.set_defaults(fn=cmd_profile)
+
+    pf = sub.add_parser(
+        "perf",
+        help="static device cost model of a preset train step: "
+             "per-family FLOPs/bytes, roofline verdicts, activation-peak "
+             "estimate, XLA cross-check (analysis/costmodel; exit 1 on "
+             "JX007/JX008)")
+    pf.add_argument("--preset", required=True,
+                    choices=("resnet50", "tiny_resnet", "charlstm"))
+    pf.add_argument("--batch", type=int, default=8,
+                    help="abstract batch size to model the step at")
+    pf.add_argument("--timesteps", type=int, default=16,
+                    help="abstract sequence length for recurrent presets")
+    pf.add_argument("--image-size", type=int, default=None,
+                    help="override preset image size (resnet50)")
+    pf.add_argument("--classes", type=int, default=None,
+                    help="override preset class count (resnet50)")
+    pf.add_argument("--tolerance", type=float, default=0.10,
+                    help="JX007 cross-check tolerance vs XLA "
+                         "cost_analysis")
+    pf.add_argument("--xla", action="store_true",
+                    help="compile the step for the XLA cost_analysis "
+                         "cross-check (expensive; skipped when the "
+                         "backend does not expose it)")
+    pf.add_argument("--no-vs-prior", action="store_true",
+                    help="skip the FLOP-drift check against the newest "
+                         "committed BENCH_r*.json")
+    pf.add_argument("--top", type=int, default=20,
+                    help="family-table rows to print")
+    pf.add_argument("--json", default=None, metavar="PATH",
+                    help="machine-readable report ('-' = stdout)")
+    pf.set_defaults(fn=cmd_perf)
 
     m = sub.add_parser(
         "metrics",

@@ -26,6 +26,8 @@ from deeplearning4j_tpu.nn.params import (
     params_to_flat,
 )
 from deeplearning4j_tpu.utils import blackbox as _blackbox
+from deeplearning4j_tpu.utils import devprof as _devprof
+from deeplearning4j_tpu.utils import faultpoints as _faults
 from deeplearning4j_tpu.utils import health as _health
 from deeplearning4j_tpu.utils import metrics as _metrics
 from deeplearning4j_tpu.utils import tracing as _tracing
@@ -162,6 +164,45 @@ class NetworkBase:
         donate = (0, 2) if jax.default_backend() != "cpu" else ()
         self._donate_argnums = donate
         return donate
+
+    # -- model FLOPs (the MFU numerator) -------------------------------------
+
+    def model_flops_per_example(self):
+        """(per-example optimizer-step FLOPs, source) for live MFU
+        accounting (utils/devprof, PerformanceListener). Lazily the
+        analytic per-layer estimate; upgraded to the jaxpr cost model
+        when one is attached (`attach_cost_model` — bench.py and
+        `cli perf` do). (None, source) when the conf carries no
+        InputType to estimate from."""
+        v = getattr(self, "_flops_per_example", None)
+        if v is None:
+            from deeplearning4j_tpu.utils import flops as _flops
+
+            v = self._flops_per_example = \
+                _flops.analytic_step_flops_per_example(self.conf)
+        return v
+
+    def set_model_flops_per_example(self, flops, source: str = "costmodel"):
+        self._flops_per_example = (float(flops), str(source))
+        return self
+
+    def attach_cost_model(self, cm, batch: Optional[int] = None):
+        """Adopt an analysis/costmodel.CostModel as this net's FLOP and
+        static-memory accounting: live MFU gauges switch to its model
+        FLOPs (source "costmodel") and the `device_memory_bytes{kind=
+        activations_est}` watermark and OOM forensics use its
+        liveness-based activation peak."""
+        b = batch or cm.batch or 1
+        self.set_model_flops_per_example(cm.model_flops / max(1, b))
+        self._cost_model_meta = {
+            "activation_peak_bytes": cm.activation_peak_bytes,
+            "resident_bytes": cm.resident_bytes,
+            "largest_activation": cm.largest_activation,
+            "model_flops": cm.model_flops,
+            "batch": b,
+            "source": "costmodel",
+        }
+        return self
 
     # -- static analysis -----------------------------------------------------
 
@@ -321,6 +362,7 @@ class NetworkBase:
                     "determined (excluded from fit_examples_total — "
                     "an under-report made explicit, not silent)").labels(),
                 "recorder": _blackbox.get_recorder(),
+                "devprof": _devprof.get_profiler(),
             }
         return ins
 
@@ -350,6 +392,10 @@ class NetworkBase:
         t0 = time.perf_counter()
         with _tracing.span("fit/step", data_wait_ms=round(data_wait * 1e3, 3)):
             with _tracing.span("fit/dispatch"):
+                # chaos hook: an `oom` fault here is a device allocator
+                # failure mid-fit — it unwinds through _run_fit's OOM
+                # forensics exactly as a real RESOURCE_EXHAUSTED would
+                _faults.fault_point("train_step")
                 fit_fn()
             dispatch = time.perf_counter() - t0
             if _tracing.is_enabled() and self._score is not None:
@@ -369,6 +415,9 @@ class NetworkBase:
         ins["recorder"].record_step(self.iteration - 1, score=self._score,
                                     data_wait=data_wait, dispatch=dispatch,
                                     sync=sync)
+        # device-side accounting: two integer ops on unsampled steps,
+        # one blocking score read every sample_every-th (utils/devprof)
+        ins["devprof"].on_step(self, n_examples, self._score)
         hb = self._fit_heartbeat
         if hb is not None:
             hb.beat()
@@ -432,12 +481,27 @@ class NetworkBase:
                 f"fit step exceeded hang_timeout={hang_timeout}s without "
                 f"progress (see flight-recorder dump)",
                 dump_path=self._hang_dump_path) from None
+        except Exception as e:
+            # device allocator failure: capture the largest live buffers
+            # + the static activation estimate BEFORE unwinding (the
+            # buffers are gone once the frames release their references),
+            # then let the original exception carry on
+            if _devprof.is_oom(e):
+                path = _devprof.oom_forensics("fit", e, net=self)
+                logger.error("RESOURCE_EXHAUSTED in fit; OOM forensics "
+                             "dump at %s", path)
+            raise
         finally:
             self._fit_heartbeat = None
             # resume coordinates die with the fit: a preemption save
             # AFTER a completed fit must record a clean epoch boundary,
             # not a stale mid-epoch position
             self._train_state = None
+            # the devprof sampling window dies with the fit too: a
+            # stale last-sample timestamp would make the NEXT fit's
+            # first window span the inter-fit idle gap and publish
+            # garbage step-time/MFU gauges
+            self._devprof_state = None
             _health.get_health().unregister(hb)
             # pipeline workers this fit created must die with it, raise
             # or return (the generators' own finally handles the common

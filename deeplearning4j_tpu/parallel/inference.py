@@ -791,7 +791,16 @@ class ParallelInference:
                 out = [np.asarray(o)[:n] for o in out]
             else:
                 out = np.asarray(out)[:n]
-        except BaseException:
+        except BaseException as e:
+            from deeplearning4j_tpu.utils import devprof as _devprof
+
+            if _devprof.is_oom(e):
+                # a serving-forward allocator failure gets the same
+                # forensics as a fit-loop one: top live buffers + static
+                # estimate into a flight-recorder dump, then the group
+                # fails as usual (ReplicaPool does not retry in-flight)
+                _devprof.oom_forensics("serving_forward", e,
+                                       net=self.model)
             with self._lock:
                 if (not self._shape_confirmed
                         and self._expected_shape == padded.shape[1:]):

@@ -61,6 +61,8 @@ class PerformanceListener(IterationListener):
         self._samples = 0
         self._iters = 0
         self._etl_ms = 0.0
+        self._fit_examples = None  # registry child, resolved lazily
+        self._win_examples0 = None  # counter value at window start
 
     def iteration_done(self, model, iteration, info):
         now = time.perf_counter()
@@ -73,19 +75,69 @@ class PerformanceListener(IterationListener):
         self._etl_ms += info.get("etl_ms", 0.0)
         if self._last_time is None:
             self._last_time = now
+            self._win_examples0 = self._fit_examples_total()
             return
         if self._iters % self.frequency == 0:
             dt = now - self._last_time
             if dt > 0:
-                self.print_fn(
+                msg = (
                     f"iter {iteration}: {self._iters / dt:.1f} it/s, "
                     f"{self._samples / dt:.1f} samples/s, "
                     f"etl {self._etl_ms / self._iters:.1f} ms/iter"
                 )
+                mfu = self._window_mfu(model, dt)
+                if mfu is not None:
+                    msg += f", mfu {mfu:.3f}"
+                self.print_fn(msg)
             self._last_time = now
             self._samples = 0
             self._iters = 0
             self._etl_ms = 0.0
+            self._win_examples0 = self._fit_examples_total()
+
+    def _fit_examples_total(self):
+        """The fit loop's own once-per-batch example counter — NOT the
+        per-callback tally: TBPTT fires iteration_done once per segment
+        with the full batch size, so `self._samples` over-counts by the
+        segment count and must never feed the MFU arithmetic. (The
+        counter is process-global: a second net fitting concurrently in
+        the same process would inflate this window's MFU.)"""
+        try:
+            from deeplearning4j_tpu.utils.metrics import get_registry
+
+            child = self._fit_examples
+            if child is None:
+                child = self._fit_examples = get_registry().counter(
+                    "fit_examples_total").labels()
+            return child.value
+        except Exception:
+            return None
+
+    def _window_mfu(self, model, dt: float):
+        """Window-averaged MFU from the net's model FLOPs (jaxpr cost
+        model when one is attached, analytic estimate otherwise — the
+        same accounting as utils/devprof's step_mfu gauge). Only on
+        device backends: chip-peak MFU against a CPU host is noise."""
+        per_example = getattr(model, "model_flops_per_example", None)
+        if per_example is None or self._win_examples0 is None:
+            return None
+        try:
+            import jax
+
+            if jax.default_backend() == "cpu":
+                return None
+            flops, _ = per_example()
+            if not flops:
+                return None
+            examples = self._fit_examples_total()
+            if examples is None:
+                return None
+            from deeplearning4j_tpu.utils.flops import peak_flops_per_chip
+
+            return ((examples - self._win_examples0) * flops / dt
+                    / peak_flops_per_chip())
+        except Exception:
+            return None
 
 
 class CollectScoresIterationListener(IterationListener):

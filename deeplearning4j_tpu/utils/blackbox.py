@@ -150,8 +150,14 @@ class FlightRecorder:
     def record_metrics_delta(self):
         """Scalar registry delta since the previous capture — cheap
         (value reads, no histogram sorting), so counters' recent movement
-        rides along in a crash dump."""
+        rides along in a crash dump. The `device_memory_bytes{...}`
+        watermark gauges (utils/devprof) additionally ride along as
+        ABSOLUTE values per capture: a delta view of a watermark hides
+        the level, and the level trajectory is exactly what a post-OOM
+        dump needs to show."""
         now = _metrics.get_registry().scalar_values()
+        memory = {k: v for k, v in now.items()
+                  if k.startswith("device_memory_bytes")}
         with self._lock:
             prev = self._last_scalars
             self._last_scalars = now
@@ -162,10 +168,12 @@ class FlightRecorder:
                 dv = v - prev.get(k, 0.0)
                 if dv:
                     delta[k] = round(dv, 9)
-            if delta:
-                self._metrics_deltas.append(
-                    {"ts": round(time.time(), 3),
-                     "step": self._step_count, "delta": delta})
+            if delta or memory:
+                entry = {"ts": round(time.time(), 3),
+                         "step": self._step_count, "delta": delta}
+                if memory:
+                    entry["memory"] = memory
+                self._metrics_deltas.append(entry)
 
     def on_degradation(self, component: str, stalled_for: float,
                        threads: List[str]):
@@ -377,15 +385,55 @@ def render_dump(doc: dict, max_steps: int = 32,
         lines.append("")
         lines.append(f"events (newest last, {len(events)}):")
         for ev in events[-max_steps:]:
+            if ev.get("kind") == "oom":
+                lines.append(f"  {ev.get('ts')}  oom  "
+                             f"where={ev.get('where')} "
+                             "(see OOM forensics below)")
+                continue
             extra = {k: v for k, v in ev.items() if k not in ("ts", "kind")}
             lines.append(f"  {ev.get('ts')}  {ev.get('kind')}"
                          + (f"  {extra}" if extra else ""))
+    oom = next((ev for ev in reversed(events)
+                if ev.get("kind") == "oom"), None)
+    if oom is not None:
+        lines.append("")
+        lines.append(f"OOM forensics — where: {oom.get('where')}")
+        lines.append(f"  error: {oom.get('error')}")
+        static = oom.get("static") or {}
+        for key in ("params_bytes", "updater_bytes",
+                    "activation_peak_bytes", "live_bytes"):
+            v = static.get(key)
+            if isinstance(v, (int, float)):
+                lines.append(f"  {key}: {v / 2**20:.2f} MiB")
+        la = static.get("largest_activation")
+        if la:
+            lines.append(f"  largest static activation: shape "
+                         f"{la.get('shape')} {la.get('dtype')} "
+                         f"({la.get('bytes', 0) / 2**20:.2f} MiB)")
+        top = oom.get("top_buffers") or []
+        if top:
+            lines.append(f"  largest live buffers ({len(top)}):")
+            for b in top:
+                lines.append(
+                    f"    {b.get('nbytes', 0) / 2**20:9.2f} MiB  "
+                    f"{b.get('dtype')}{list(b.get('shape') or ())}")
     deltas = doc.get("metrics_deltas") or []
     if deltas:
         lines.append("")
         lines.append("last metrics delta:")
         for k, v in sorted((deltas[-1].get("delta") or {}).items()):
             lines.append(f"  {k}: {v:+g}")
+        trajectory = [d for d in deltas if d.get("memory")]
+        if trajectory:
+            lines.append("")
+            lines.append("device memory trajectory "
+                         f"({len(trajectory)} captures, MiB):")
+            for d in trajectory[-8:]:
+                parts = []
+                for k, v in sorted(d["memory"].items()):
+                    kind = k.split("kind=")[-1].strip('"}')
+                    parts.append(f"{kind}={v / 2**20:.1f}")
+                lines.append(f"  step {d.get('step')}: {', '.join(parts)}")
     health = doc.get("health")
     if health:
         lines.append("")

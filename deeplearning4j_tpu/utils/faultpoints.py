@@ -18,15 +18,18 @@ sequence lived in a shell history. This module makes faults data:
       helper_fn         ops/helpers guarded kernel dispatch
       replica_forward   parallel/inference device forward
       http_handler      utils/jsonhttp request dispatch
+      train_step        nn/netbase fit-loop dispatch
 
   With no plan installed a fault point is one global read and a `None`
   compare — hot-path safe by construction.
 
 * a **FaultPlan** is a seed plus a list of rules. Each rule names a
-  point, a fault kind (`error` raises FaultInjected, `latency` sleeps,
-  `hang` blocks until released or `hang_seconds` passes — long enough
-  to trip the watchdog, bounded so a chaos run can never wedge the
-  harness itself), and a schedule: `every_nth=N` (every Nth invocation
+  point, a fault kind (`error` raises FaultInjected, `oom` raises
+  InjectedOOM — a FaultInjected carrying the RESOURCE_EXHAUSTED marker
+  so the real OOM-forensics path fires, `latency` sleeps, `hang` blocks
+  until released or `hang_seconds` passes — long enough to trip the
+  watchdog, bounded so a chaos run can never wedge the harness
+  itself), and a schedule: `every_nth=N` (every Nth invocation
   of the point), `between=(a, b)` (invocation indices a..b inclusive),
   or `p=0.1` (an independent coin per invocation, drawn from a RNG
   seeded by (plan seed, point, rule index) — NOT wall-clock, NOT a
@@ -55,7 +58,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
-KINDS = ("error", "latency", "hang")
+KINDS = ("error", "latency", "hang", "oom")
 
 # the sanctioned point names — fault_point() accepts any name (a new
 # call site should not need a registry edit to exist), but plans naming
@@ -69,6 +72,7 @@ KNOWN_POINTS = (
     "helper_fn",
     "replica_forward",
     "http_handler",
+    "train_step",
 )
 
 
@@ -77,11 +81,27 @@ class FaultInjected(RuntimeError):
     name so handlers (and test assertions) can tell injected faults from
     organic ones."""
 
-    def __init__(self, point: str, invocation: int):
+    def __init__(self, point: str, invocation: int,
+                 message: Optional[str] = None):
         super().__init__(
-            f"injected fault at {point!r} (invocation {invocation})")
+            message
+            or f"injected fault at {point!r} (invocation {invocation})")
         self.point = point
         self.invocation = invocation
+
+
+class InjectedOOM(FaultInjected):
+    """An `oom`-kind fault: a FaultInjected whose message carries the
+    RESOURCE_EXHAUSTED marker, so it takes exactly the code path a real
+    device allocator failure takes (utils/devprof.is_oom recognizes it,
+    the fit loop / serving dispatcher run their OOM forensics on it) —
+    the deterministic way to rehearse an OOM end to end."""
+
+    def __init__(self, point: str, invocation: int):
+        super().__init__(
+            point, invocation,
+            f"RESOURCE_EXHAUSTED: injected oom at {point!r} "
+            f"(invocation {invocation}) — out of memory rehearsal")
 
 
 class FaultRule:
@@ -315,6 +335,8 @@ def fault_point(point: str, **ctx) -> None:
     _observe(point, rule.kind, inv, ctx)
     if rule.kind == "error":
         raise FaultInjected(point, inv)
+    if rule.kind == "oom":
+        raise InjectedOOM(point, inv)
     if rule.kind == "latency":
         time.sleep(rule.latency_ms / 1e3)
         return

@@ -1,15 +1,28 @@
-"""Analytic FLOP estimates for MFU reporting.
+"""FLOP accounting for MFU reporting — now a thin wrapper over the
+jaxpr cost model (analysis/costmodel.py).
 
-The standard model-FLOPs accounting (as in the MFU literature): a matmul or
-conv contributes 2·MACs forward; a training step costs ≈ 3× forward (one
-forward + two matmul-shaped backward passes). Elementwise/normalization
-work is excluded — it is bandwidth-, not FLOPs-bound on TPU, and excluding
-it makes MFU comparable across frameworks.
+`train_step_flops_for(net, batch)` is the one entry point: it traces the
+net's actual optimizer step and returns the MXU-family FLOPs the program
+really runs (source `"costmodel"`). The hand-written per-layer estimator
+below — 2·MACs forward × 3 for the step, the original MFU arithmetic —
+is demoted to the fallback for nets the cost model cannot trace (no
+InputType on the conf) and to the cheap lazy default the fit loop's
+devprof sampling starts from; every surfaced number carries its
+`flops_source` so the two accountings can never be silently conflated.
+Elementwise/normalization work stays excluded from the MFU numerator in
+BOTH accountings (bandwidth-, not FLOPs-bound on TPU; exclusion keeps
+MFU comparable across frameworks).
+
+Chip tables (peak matmul FLOP/s, HBM size, HBM bandwidth) live here too
+— the denominators of MFU and the roofline ridge.
 """
 
 from __future__ import annotations
 
-from typing import Optional
+import logging
+from typing import Optional, Tuple
+
+logger = logging.getLogger("deeplearning4j_tpu")
 
 from deeplearning4j_tpu.nn.conf import layers as L
 from deeplearning4j_tpu.nn.conf.graph import (
@@ -88,9 +101,103 @@ def mln_forward_flops(conf) -> Optional[float]:
 
 
 def train_step_flops(forward_flops: float, batch: int) -> float:
-    """Model FLOPs of one optimizer step: 3× forward (fwd + grad wrt
-    activations + grad wrt weights), times the batch."""
+    """Analytic model FLOPs of one optimizer step: 3× forward (fwd +
+    grad wrt activations + grad wrt weights), times the batch."""
     return 3.0 * forward_flops * batch
+
+
+def forward_flops(conf) -> Optional[float]:
+    """Per-example analytic forward FLOPs of either conf flavor."""
+    from deeplearning4j_tpu.nn.conf.graph import (
+        ComputationGraphConfiguration,
+    )
+
+    if isinstance(conf, ComputationGraphConfiguration):
+        return graph_forward_flops(conf)
+    return mln_forward_flops(conf)
+
+
+def _unbounded_recurrent(conf) -> bool:
+    """Does this conf consume recurrent input with NO fixed timestep
+    count? The per-layer walk then prices one timestep, and a
+    "per-example" number derived from it would be ~seq_len× off."""
+    its = getattr(conf, "input_types", None) \
+        or (getattr(conf, "input_type", None),)
+    return any(isinstance(it, RecurrentInput) and not it.timesteps
+               for it in its if it is not None)
+
+
+def analytic_step_flops_per_example(conf) -> Tuple[Optional[float], str]:
+    """(per-example optimizer-step FLOPs, "analytic") — the lazy default
+    devprof's live MFU gauges start from. Recurrent confs without a
+    fixed timestep count return (None, "analytic"): the walk prices ONE
+    timestep, and reporting that as per-example would publish an MFU
+    ~seq_len× too small — no number beats a confidently wrong one
+    (attach a cost model, or fix the InputType's timesteps)."""
+    if _unbounded_recurrent(conf):
+        return None, "analytic"
+    fwd = forward_flops(conf)
+    if fwd is None or fwd <= 0:
+        return None, "analytic"
+    return 3.0 * fwd, "analytic"
+
+
+def train_step_flops_for(net, batch: int, *, timesteps: int = 16,
+                         prefer_cost_model: bool = True
+                         ) -> Tuple[Optional[float], str]:
+    """Model FLOPs of one of `net`'s optimizer steps at `batch` —
+    `(flops, source)` where source is `"costmodel"` (jaxpr trace of the
+    real step, MXU families only) or `"analytic"` (the per-layer
+    fallback). The trace runs with vendor helpers disabled: model FLOPs
+    are implementation-independent, and opaque pallas custom calls
+    would otherwise count zero."""
+    if prefer_cost_model:
+        try:
+            from deeplearning4j_tpu.analysis.costmodel import (
+                train_step_cost,
+            )
+
+            with _helpers_disabled():
+                cm = train_step_cost(net, batch_size=batch,
+                                     timesteps=timesteps)
+            if cm.model_flops > 0:
+                return cm.model_flops, "costmodel"
+        except Exception:
+            logger.warning(
+                "cost-model FLOP trace failed; falling back to the "
+                "analytic per-layer estimate", exc_info=True)
+    fwd = forward_flops(net.conf)
+    if fwd is None or fwd <= 0:
+        return None, "analytic"
+    if _unbounded_recurrent(net.conf):
+        fwd *= timesteps  # the analytic walk priced ONE timestep
+    return train_step_flops(fwd, batch), "analytic"
+
+
+class _helpers_disabled:
+    """Disable every registered vendor helper for the duration of a
+    cost-model trace, restoring the caller's kill-switch state on exit
+    (the same save/restore discipline as bench._run_ab)."""
+
+    _OPS = ("conv2d", "batch_norm", "lstm_sequence")
+
+    def __enter__(self):
+        from deeplearning4j_tpu.ops.helpers import (
+            helper_enabled,
+            set_helper_enabled,
+        )
+
+        self._set = set_helper_enabled
+        self._saved = {op: helper_enabled(op) for op in self._OPS}
+        for op in self._OPS:
+            set_helper_enabled(op, False)
+        return self
+
+    def __exit__(self, *exc):
+        for op, enabled in self._saved.items():
+            if enabled is not None:
+                self._set(op, enabled)
+        return False
 
 
 # bf16 peak matmul throughput per chip, for MFU. v5e: 197 TFLOP/s.
@@ -102,21 +209,57 @@ TPU_PEAK_FLOPS = {
     "v6e": 918e12,
 }
 
+# HBM capacity per chip — the JX008 residency ceiling.
+TPU_HBM_BYTES = {
+    "v5e": 16e9,
+    "v5litepod": 16e9,
+    "v4": 32e9,
+    "v5p": 95e9,
+    "v6e": 32e9,
+}
 
-def peak_flops_per_chip(default: float = 197e12) -> float:
-    """Best-effort peak bf16 FLOP/s of the current chip."""
+# HBM bandwidth per chip — the roofline ridge denominator.
+TPU_HBM_BANDWIDTH = {
+    "v5e": 819e9,
+    "v5litepod": 819e9,
+    "v4": 1228e9,
+    "v5p": 2765e9,
+    "v6e": 1640e9,
+}
+
+
+def _chip_lookup(table: dict, env_var: str, default):
     import os
 
-    env = os.environ.get("BENCH_PEAK_FLOPS")
+    env = os.environ.get(env_var)
     if env:
         return float(env)
     try:
         import jax
 
         kind = jax.devices()[0].device_kind.lower().replace(" ", "")
-        for key, val in TPU_PEAK_FLOPS.items():
+        for key, val in table.items():
             if key in kind:
                 return val
     except Exception:
         pass
     return default
+
+
+def peak_flops_per_chip(default: float = 197e12) -> float:
+    """Best-effort peak bf16 FLOP/s of the current chip."""
+    return _chip_lookup(TPU_PEAK_FLOPS, "BENCH_PEAK_FLOPS", default)
+
+
+def peak_hbm_bytes_per_chip(default: Optional[float] = None
+                            ) -> Optional[float]:
+    """HBM capacity of the current chip; None off-TPU (a CPU host's RAM
+    is not the ceiling the JX008 check is about) unless BENCH_HBM_BYTES
+    forces one."""
+    return _chip_lookup(TPU_HBM_BYTES, "BENCH_HBM_BYTES", default)
+
+
+def hbm_bandwidth_per_chip(default: float = 819e9) -> float:
+    """HBM bandwidth of the current chip (roofline ridge); the v5e
+    figure stands in off-TPU — the roofline is a TPU-shaped model."""
+    return _chip_lookup(TPU_HBM_BANDWIDTH, "BENCH_HBM_BANDWIDTH", default)

@@ -102,24 +102,68 @@ def family_summary(rows: List[Tuple[str, float]]) -> List[Tuple[str, float]]:
     return fam.most_common()
 
 
+# XLA op-family name fragments -> cost-model primitive families, for the
+# best-effort flops/bytes columns next to measured device time (fusions
+# like convert_reduce_fusion have no single-primitive identity and stay
+# unannotated — the full cost-model table rides in the `cost_model` block)
+_FAMILY_TO_PRIMITIVE = (
+    ("convolution", "conv_general_dilated"),
+    ("dot", "dot_general"),
+    ("gemm", "dot_general"),
+    ("select-and-scatter", "select_and_scatter_add"),
+    ("reduce-window", "reduce_window_sum"),
+)
+
+
+def roofline_columns(families_ms: dict, cost_model: Optional[dict]) -> dict:
+    """Annotate measured XLA op families with the static cost model's
+    flops/bytes where the family maps to ONE primitive (convolution ->
+    conv_general_dilated, dot -> dot_general); fusions stay time-only.
+    Gives PROFILE_*.md tables their roofline context columns."""
+    if not cost_model:
+        return {name: {"ms": ms} for name, ms in families_ms.items()}
+    prim_fams = cost_model.get("families") or {}
+    out = {}
+    for name, ms in families_ms.items():
+        row = {"ms": ms}
+        low = name.lower()
+        for frag, prim in _FAMILY_TO_PRIMITIVE:
+            fc = prim_fams.get(prim)
+            if frag in low and fc:
+                row["flops"] = fc.get("flops")
+                row["bytes"] = fc.get("bytes")
+                row["cost_model_family"] = prim
+                break
+        out[name] = row
+    return out
+
+
 def write_profile_json(log_dir: str, path: str, top_ops: int = 40,
-                       meta: Optional[dict] = None) -> dict:
+                       meta: Optional[dict] = None,
+                       cost_model: Optional[dict] = None) -> dict:
     """Export the op-family aggregation of the newest trace in log_dir as
     a JSON artifact, so bench runs attach device-time breakdowns
     mechanically instead of by hand. Returns the payload (families empty
-    when no xplane/proto is available — same degradation as op_summary)."""
+    when no xplane/proto is available — same degradation as op_summary).
+    With `cost_model` (analysis/costmodel CostModel.to_dict()), the
+    export carries per-family flops/bytes/roofline context next to the
+    measured times instead of time alone."""
     rows = op_summary(log_dir, top=1_000_000)
     fams = family_summary(rows)
+    families_ms = {name: round(sec * 1e3, 3) for name, sec in fams}
     payload = {
         "meta": meta or {},
         "log_dir": os.path.abspath(log_dir),
         "total_device_sec": round(sum(s for _, s in rows), 6),
-        "families_ms": {name: round(sec * 1e3, 3) for name, sec in fams},
+        "families_ms": families_ms,
         "top_ops_ms": [
             {"op": name, "ms": round(sec * 1e3, 3)}
             for name, sec in rows[:top_ops]
         ],
     }
+    if cost_model is not None:
+        payload["cost_model"] = cost_model
+        payload["families"] = roofline_columns(families_ms, cost_model)
     with open(path, "w") as f:
         json.dump(payload, f, indent=2)
     logger.info("profile JSON written to %s (%d families)", path, len(fams))

@@ -74,5 +74,9 @@ grep -a '^T1 THREAD GUARD:' /tmp/_t1.log || echo "T1 THREAD GUARD: no verdict li
 # save that died between write and atomic rename (conftest scans the
 # run's tmp dirs — same spirit as the thread-leak guard)
 grep -a '^T1 CKPT TMP GUARD:' /tmp/_t1.log || echo "T1 CKPT TMP GUARD: no verdict line (session died early?)"
+# perf snapshot: the static cost model's totals for the tiny preset
+# (conftest recomputes per session) — accidental FLOP-model drift shows
+# up here as a changed number, not as a silently re-based MFU claim
+grep -a '^T1 PERF SNAPSHOT:' /tmp/_t1.log || echo "T1 PERF SNAPSHOT: no verdict line (session died early?)"
 echo "T1 OK: $(wc -l < "$artifact" | tr -d ' ') failing (all within the $(wc -l < "$baseline" | tr -d ' ')-name baseline); artifact: $artifact"
 exit 0

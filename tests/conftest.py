@@ -41,6 +41,14 @@ if _bb_artifact:
 
     install_crash_hooks(_bb_artifact)
 
+# Device-profiler sampling OFF under tier-1 (utils/devprof): the sampled
+# block_until_ready would add timing jitter to every fit-heavy test on a
+# loaded CI box. Tests that exercise the sampler configure it locally
+# (and restore) — the suite's default stays timing-stable.
+from deeplearning4j_tpu.utils import devprof as _devprof  # noqa: E402
+
+_devprof.configure(sample_every=0)
+
 
 def pytest_configure(config):
     config.addinivalue_line(
@@ -136,6 +144,26 @@ def pytest_sessionfinish(session, exitstatus):
             print(f"T1 CKPT TMP GUARD:   {p}")
     else:
         print("T1 CKPT TMP GUARD: ok (no orphaned checkpoint tmp files)")
+
+    # Perf snapshot (scripts/t1.sh greps the verdict): the static cost
+    # model's totals for the tiny preset, recomputed every session — a
+    # FLOP-accounting change (a costmodel.py edit, a new primitive rule)
+    # moves these numbers, so accidental model drift is visible in the
+    # gate output instead of silently re-basing every MFU claim.
+    try:
+        from deeplearning4j_tpu.analysis.costmodel import train_step_cost
+        from deeplearning4j_tpu.models.resnet import tiny_resnet_conf
+        from deeplearning4j_tpu.nn.compgraph import ComputationGraph
+
+        _cm = train_step_cost(ComputationGraph(tiny_resnet_conf()).init(),
+                              batch_size=2)
+        print(f"T1 PERF SNAPSHOT: tiny_resnet(batch=2) "
+              f"model_flops={_cm.model_flops:.0f} "
+              f"flops_total={_cm.flops_total:.0f} "
+              f"bytes_total={_cm.bytes_total:.0f} "
+              f"activation_peak_bytes={_cm.activation_peak_bytes}")
+    except Exception as e:  # the snapshot must never fail the suite
+        print(f"T1 PERF SNAPSHOT: unavailable ({type(e).__name__}: {e})")
 
     # Opt-in observability artifact (scripts/t1.sh T1_METRICS_DUMP=1):
     # dump the process-global metrics registry after the run so compile
