@@ -873,6 +873,225 @@ def bench_input_pipeline(n_batches=48, batch=64, img=24, classes=10,
     }
 
 
+# -- multi-chip mode ----------------------------------------------------------
+
+
+def _n_multichip_devices() -> int:
+    return int(os.environ.get("BENCH_MULTICHIP_DEVICES", "8"))
+
+
+def _legacy_param_averaging_fit(make_net, shard_datasets, steps):
+    """The vs_alternate arm: DL4J ParallelWrapper semantics — each
+    "worker" trains a full replica step on its own shard from the same
+    start params (the real `_fit_dataset` machinery, so TBPTT nets run
+    their real segment dispatch), then parameters + updater state are
+    averaged THROUGH THE HOST every interval
+    (ParallelWrapper.java:417-424, frequency 1). This is exactly the
+    per-interval params-to-host round-trip the in-graph all-reduce
+    removes; measuring it next to the sharded step is the honesty
+    mechanism. Replicas dispatch sequentially — what a GIL-bound host
+    orchestrator does on one box — so the arm is a mechanism A/B, not a
+    tuned rival."""
+    import jax.numpy as jnp
+
+    net = make_net()
+    # REAL buffer copies, not aliases: on device backends the step jit
+    # donates argnums (0, 2), so each replica must dispatch its OWN
+    # copy of the start params/updater — an aliased p0 would be deleted
+    # by the first replica's donation (and the legacy semantics DO copy
+    # the source model into every replica)
+    copy_tree = lambda t: jax.tree_util.tree_map(jnp.copy, t)
+    avg = lambda trees: jax.tree_util.tree_map(
+        lambda *xs: np.mean([np.asarray(x) for x in xs], axis=0), *trees)
+    t_total = None
+    for _ in range(2):  # warmup pass (compile), then the timed pass
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            p0 = copy_tree(net.params_list)
+            u0 = copy_tree(net.upd_state)
+            s0 = list(net.state_list)
+            it0 = net.iteration
+            outs = []
+            for ds in shard_datasets:
+                net.params_list = copy_tree(p0)
+                net.upd_state = copy_tree(u0)
+                net.state_list, net.iteration = list(s0), it0
+                net._fit_dataset(ds)
+                outs.append((net.params_list, net.upd_state))
+            # the legacy averaging interval: every replica's params and
+            # updater state round-trip to host numpy, mean, re-upload
+            net.params_list = avg([o[0] for o in outs])
+            net.upd_state = avg([o[1] for o in outs])
+        _sync(net)
+        t_total = time.perf_counter() - t0
+    return t_total
+
+
+def _bench_multichip(workload: str):
+    """Multi-chip training A/B on an n-device mesh (CPU boxes force the
+    host-platform device count — the same virtual-mesh strategy as the
+    MULTICHIP_r0x dryruns; the numbers are mechanism evidence there, not
+    silicon claims — `backend` says which). Three arms per workload:
+
+      sharded         — the mainline path: fit() with set_mesh, global
+                        batch = n × per-chip batch, ONE jitted SPMD step,
+                        in-graph gradient all-reduce.
+      single_chip     — the same per-chip batch on one device: the
+                        scaling-efficiency denominator.
+      param_averaging — the legacy DL4J semantics (vs_alternate): per-
+                        replica steps + host-side parameter averaging.
+
+    Reported: per-chip throughput, scaling efficiency (sharded per-chip
+    / single-chip), and the legacy arm under `vs_alternate` — the same
+    A/B honesty mechanism as the kernel benches. MFU is per-chip-correct:
+    model FLOPs divide by the data-axis size (`flops_source` recorded)."""
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.parallel.mesh import data_parallel_mesh
+    from deeplearning4j_tpu.utils.metrics import get_registry
+
+    n = jax.device_count()
+    on_tpu = jax.default_backend() not in ("cpu",)
+    rng = np.random.default_rng(0)
+
+    if workload == "resnet50":
+        from deeplearning4j_tpu.models.resnet import resnet50_conf
+        from deeplearning4j_tpu.nn.compgraph import ComputationGraph
+
+        per_chip, steps, image_size, classes = (
+            (128, 8, 224, 1000) if on_tpu else (4, 2, 64, 10))
+        conf = resnet50_conf(num_classes=classes, image_size=image_size,
+                             precision="bf16" if on_tpu else "f32")
+        refusal = _doctor_refusal(conf, "images/sec/chip")
+        if refusal is not None:
+            return refusal
+        make_net = lambda: ComputationGraph(conf).init()
+        gb = per_chip * n
+        x = rng.random((gb, image_size, image_size, 3), np.float32)
+        ds = DataSet(x, _onehot(rng, gb, classes))
+        unit, per_step_examples, timesteps = "images/sec/chip", gb, 16
+    elif workload == "char_lstm":
+        from deeplearning4j_tpu.models.charlstm import char_lstm_conf
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        vocab = 77
+        per_chip, seq_len, tbptt, hidden, steps = (
+            (64, 200, 50, 200, 8) if on_tpu else (2, 32, 16, 48, 2))
+        conf = char_lstm_conf(vocab_size=vocab, hidden=hidden,
+                              tbptt_length=tbptt,
+                              precision="bf16" if on_tpu else "f32")
+        refusal = _doctor_refusal(conf, "tokens/sec/chip")
+        if refusal is not None:
+            return refusal
+        make_net = lambda: MultiLayerNetwork(conf).init()
+        gb = per_chip * n
+        idx = rng.integers(0, vocab, (gb, seq_len))
+        x = np.eye(vocab, dtype=np.float32)[idx]
+        yidx = rng.integers(0, vocab, (gb, seq_len))
+        ds = DataSet(x, np.eye(vocab, dtype=np.float32)[yidx])
+        unit = "tokens/sec/chip"
+        per_step_examples = gb * seq_len
+        timesteps = seq_len
+    else:
+        raise SystemExit(f"unknown multichip workload {workload!r}")
+
+    # model FLOPs from an unsharded throwaway trace (mesh-independent);
+    # the PER-CHIP figure divides by the data-axis size — the accounting
+    # fix that keeps multi-chip MFU honest
+    step_flops, flops_source = _step_flops(make_net, gb,
+                                           timesteps=timesteps)
+    per_chip_flops = step_flops / n if step_flops else None
+
+    reg = get_registry()
+
+    def timed_sharded():
+        mesh = data_parallel_mesh()
+        net = make_net().set_mesh(mesh)
+        if per_chip_flops:
+            net.set_model_flops_per_example(step_flops / gb, flops_source)
+        plan = net._mesh_plan
+        # pre-shard ONCE onto the mesh: the prefetch placement then
+        # detects the committed sharding and passes through zero-copy
+        # (the contract tests/test_sharded_step.py pins; the measured
+        # fit_data_wait_mean_ms is REPORTED in the artifact — on a
+        # contended CPU box per-epoch thread spin-up keeps it nonzero)
+        staged = plan.shard_batch(ds)
+        wait = reg.histogram(
+            "fit_data_wait_seconds",
+            "time blocked on the data iterator (ETL) before a "
+            "dispatch").labels()
+        c0, s0 = wait.count, wait.sum
+        dt, n_steps = _time_fit(
+            net, lambda k: ExistingDataSetIterator([staged] * k), steps,
+            reps=3 if on_tpu else 1)
+        wait_ms = ((wait.sum - s0) / max(1, wait.count - c0)) * 1e3
+        ar = reg.counter(
+            "allreduce_bytes_total",
+            "gradient bytes all-reduced in-graph by the sharded "
+            "train step (logical payload: summed gradient leaf "
+            "bytes per optimizer step)").labels()
+        return dt, n_steps, wait_ms, int(ar.value)
+
+    def timed_single():
+        net = make_net()
+        shard_ds = DataSet(
+            jax.device_put(np.asarray(ds.features)[:per_chip]),
+            jax.device_put(np.asarray(ds.labels)[:per_chip]))
+        dt, n_steps = _time_fit(
+            net, lambda k: ExistingDataSetIterator([shard_ds] * k), steps,
+            reps=3 if on_tpu else 1)
+        return dt, n_steps
+
+    sh_dt, sh_steps, sh_wait_ms, allreduce_bytes = timed_sharded()
+    si_dt, si_steps = timed_single()
+
+    # legacy arm: per-shard device-resident batches, host averaging
+    shards = []
+    for s in range(n):
+        sl = slice(s * per_chip, (s + 1) * per_chip)
+        shards.append(DataSet(
+            jnp.asarray(np.asarray(ds.features)[sl]),
+            jnp.asarray(np.asarray(ds.labels)[sl])))
+    vs_alt_err = None
+    try:
+        avg_dt = _legacy_param_averaging_fit(make_net, shards, steps)
+    except Exception as e:
+        avg_dt, vs_alt_err = None, f"{type(e).__name__}: {e}"
+
+    # per-chip throughput: the sharded arm consumed gb examples/step
+    sharded_per_chip = per_step_examples / n * sh_steps / sh_dt
+    single_chip = per_step_examples / n * si_steps / si_dt
+    efficiency = sharded_per_chip / single_chip if single_chip else None
+    mfu = (per_chip_flops * sh_steps / sh_dt / peak_flops_per_chip()
+           if on_tpu and per_chip_flops else None)
+    out = {
+        "value": round(sharded_per_chip, 2),
+        "unit": unit,
+        "devices": n,
+        "per_chip_batch": per_chip,
+        "global_batch": gb,
+        "steps_timed": sh_steps,
+        "single_chip_value": round(single_chip, 2),
+        "scaling_efficiency": (None if efficiency is None
+                               else round(efficiency, 3)),
+        "kernel": "sharded_step_allreduce",
+        "vs_alternate": {} if avg_dt is None else {
+            "param_averaging_host": round(
+                per_step_examples / n * steps / avg_dt, 2)},
+        **({"vs_alternate_errors": {"param_averaging_host": vs_alt_err}}
+           if vs_alt_err else {}),
+        "fit_data_wait_mean_ms": round(sh_wait_ms, 3),
+        "allreduce_bytes_total": allreduce_bytes,
+        "model_flops_per_step": step_flops,
+        "model_flops_per_chip": per_chip_flops,
+        "flops_source": flops_source,
+        "mfu": None if mfu is None else round(mfu, 4),
+        "seconds": round(sh_dt + si_dt + (avg_dt or 0.0), 3),
+    }
+    return out
+
+
 WORKLOADS = {
     "resnet50": bench_resnet50,
     "lenet": bench_lenet,
@@ -912,13 +1131,16 @@ def _child_env():
     return env
 
 
-def _run_child(args, timeout):
+def _run_child(args, timeout, extra_env=None):
     """Run `python bench.py <args>` with a hard timeout; return
     (parsed-last-json-line | None, error | None)."""
     cmd = [sys.executable, os.path.abspath(__file__)] + args
+    env = _child_env()
+    if extra_env:
+        env.update(extra_env)
     try:
         proc = subprocess.run(cmd, capture_output=True, text=True,
-                              timeout=timeout, env=_child_env())
+                              timeout=timeout, env=env)
     except subprocess.TimeoutExpired:
         return None, "timeout"
     if proc.returncode != 0:
@@ -1037,6 +1259,73 @@ def _probe():
     }))
 
 
+def _workload_multichip(name):
+    """Child mode: one multi-chip workload on this process's full device
+    set (the orchestrator forced the virtual device count on CPU boxes).
+    Auto-mesh is pinned OFF here because the A/B needs all three arms
+    explicit — the sharded arm calls set_mesh itself, and the single-chip
+    baseline must NOT silently shard over the forced mesh (the t1.sh
+    smoke covers the auto-engagement default)."""
+    os.environ["DL4J_AUTO_MESH"] = "0"
+    out = _bench_multichip(name)
+    out["backend"] = jax.default_backend()
+    print(json.dumps(out))
+
+
+def main_multichip(devices=None):
+    """Multi-chip orchestrator: per-workload subprocesses like main(),
+    with the host-platform device count forced on CPU boxes (a TPU box
+    uses its real chips). Prints ONE JSON line — the committed
+    MULTICHIP_r0x artifact format."""
+    devices = devices or _n_multichip_devices()
+    probe, perr = _run_child(["--probe"], PROBE_TIMEOUT)
+    if probe is None or not probe.get("ok"):
+        print(json.dumps({"mode": "multichip",
+                          "infra_error": f"probe failed: {perr}"}))
+        return
+    backend = probe.get("backend")
+    extra = {}
+    if backend == "cpu":
+        flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+                 if "xla_force_host_platform_device_count" not in f]
+        flags.append(f"--xla_force_host_platform_device_count={devices}")
+        extra["XLA_FLAGS"] = " ".join(flags)
+    workloads, errors = {}, {}
+    for name in ("resnet50", "char_lstm"):
+        out, err = _run_child(["--workload-multichip", name], 900,
+                              extra_env=extra)
+        if out is not None:
+            child_backend = out.pop("backend", None)
+            if child_backend != backend:
+                errors[name] = (f"backend mismatch: child ran on "
+                                f"{child_backend}, probe saw {backend}")
+                continue
+            workloads[name] = out
+            print(f"[bench] multichip {name}: {json.dumps(out)}",
+                  file=sys.stderr)
+        else:
+            errors[name] = err
+            print(f"[bench] multichip {name}: ERROR {err}", file=sys.stderr)
+    # report the device count the workloads ACTUALLY ran on: off-cpu no
+    # forcing happens, so a 4-chip box must not headline "devices": 8
+    ran_on = {wl.get("devices") for wl in workloads.values()
+              if wl.get("devices")}
+    result = {
+        "metric": "multichip_scaling_efficiency",
+        "mode": "multichip",
+        "devices": ran_on.pop() if len(ran_on) == 1 else devices,
+        "backend": backend,
+        "device": probe.get("device"),
+        "note": ("cpu backend = virtual host-platform devices (mechanism "
+                 "evidence, not silicon perf)" if backend == "cpu"
+                 else None),
+        "workloads": workloads,
+    }
+    if errors:
+        result["errors"] = errors
+    print(json.dumps(result))
+
+
 def _workload(name):
     """Child mode: run one workload, print its JSON dict. The shared
     metrics-registry snapshot rides along so compile counts, helper
@@ -1122,7 +1411,13 @@ def main():
 
 
 if __name__ == "__main__":
-    if len(sys.argv) > 1 and sys.argv[1] in ("--probe", "--workload"):
+    if len(sys.argv) > 1 and sys.argv[1] == "--multichip":
+        n_dev = None
+        if "--devices" in sys.argv:
+            n_dev = int(sys.argv[sys.argv.index("--devices") + 1])
+        main_multichip(n_dev)
+    elif len(sys.argv) > 1 and sys.argv[1] in ("--probe", "--workload",
+                                               "--workload-multichip"):
         # The image's sitecustomize initializes the axon platform at
         # interpreter start, which ignores JAX_PLATFORMS from the env; a
         # config update before first backend *use* still wins.
@@ -1131,6 +1426,8 @@ if __name__ == "__main__":
             jax.config.update("jax_platforms", plat)
         if sys.argv[1] == "--probe":
             _probe()
+        elif sys.argv[1] == "--workload-multichip":
+            _workload_multichip(sys.argv[2])
         else:
             name = sys.argv[2]
             if "--overload" in sys.argv[3:]:

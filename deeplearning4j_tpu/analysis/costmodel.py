@@ -290,6 +290,10 @@ class CostModel:
     data_bytes: int = 0
     const_bytes: int = 0
     batch: Optional[int] = None
+    # data-axis shard count of the net this step was traced from (1 for
+    # single-device nets): the traced program is the GLOBAL step, so
+    # every per-chip view divides batch-sharded quantities by this
+    data_axis_shards: int = 1
 
     @property
     def flops_total(self) -> float:
@@ -308,29 +312,44 @@ class CostModel:
 
     @property
     def model_flops(self) -> float:
-        """MXU-family FLOPs only — the MFU numerator."""
+        """MXU-family FLOPs only — the MFU numerator (GLOBAL: the whole
+        traced step across all data shards)."""
         return sum(fc.flops for name, fc in self.families.items()
                    if name in MXU_FAMILIES)
 
     @property
+    def model_flops_per_chip(self) -> float:
+        """model_flops divided by the data-axis size — the per-chip MFU
+        numerator. Using the global figure against one chip's peak would
+        over-report multi-chip MFU data_axis_shards×."""
+        return self.model_flops / max(1, self.data_axis_shards)
+
+    @property
     def resident_bytes(self) -> int:
-        """Static peak-memory estimate: everything that must be in HBM
-        at once during the step (params held twice when not donated is
-        deliberately NOT modeled — JX006 audits donation separately)."""
-        return (self.param_bytes + self.updater_bytes + self.data_bytes
-                + self.const_bytes + self.activation_peak_bytes)
+        """Static peak-memory estimate PER CHIP: everything that must be
+        in one device's HBM at once during the step — params/updater/
+        consts replicated (full size per chip), data and activations
+        batch-sharded (divided by the data-axis size). Params held twice
+        when not donated is deliberately NOT modeled — JX006 audits
+        donation separately."""
+        n = max(1, self.data_axis_shards)
+        return (self.param_bytes + self.updater_bytes + self.const_bytes
+                + (self.data_bytes + self.activation_peak_bytes) // n)
 
     def roofline(self, peak_flops: Optional[float] = None,
                  hbm_bandwidth: Optional[float] = None) -> dict:
         """Program-level roofline verdict: the step-time lower bound is
         max(compute, traffic) at the given peak; the MFU ceiling is what
-        model FLOPs could at best achieve against that bound."""
+        model FLOPs could at best achieve against that bound. Per-chip:
+        a sharded step's work divides across the data axis before
+        meeting one chip's peak."""
         from deeplearning4j_tpu.utils import flops as _flops
 
         peak = peak_flops or _flops.peak_flops_per_chip()
         bw = hbm_bandwidth or _flops.hbm_bandwidth_per_chip()
-        t_compute = self.flops_total / peak
-        t_memory = self.bytes_total / bw
+        n = max(1, self.data_axis_shards)
+        t_compute = self.flops_total / n / peak
+        t_memory = self.bytes_total / n / bw
         bound = max(t_compute, t_memory, 1e-30)
         return {
             "peak_flops": peak,
@@ -340,7 +359,7 @@ class CostModel:
             "memory_seconds": t_memory,
             "bound": "compute" if t_compute >= t_memory else "memory",
             "step_time_lower_bound_seconds": bound,
-            "mfu_ceiling": self.model_flops / (peak * bound),
+            "mfu_ceiling": self.model_flops_per_chip / (peak * bound),
         }
 
     def table(self, peak_flops: Optional[float] = None,
@@ -382,6 +401,8 @@ class CostModel:
             "updater_bytes": self.updater_bytes,
             "data_bytes": self.data_bytes,
             "const_bytes": self.const_bytes,
+            "data_axis_shards": self.data_axis_shards,
+            "model_flops_per_chip": self.model_flops_per_chip,
             "resident_bytes": self.resident_bytes,
             "families": {k: v.to_dict() for k, v in self.families.items()},
         }
@@ -484,6 +505,9 @@ def _model_of_step(net, step, args, batch_size: int) -> CostModel:
     cm.param_bytes = _tree_bytes(net.params_list)
     cm.updater_bytes = _tree_bytes(net.upd_state)
     cm.data_bytes = _tree_bytes((args[3], args[4]))
+    plan = getattr(net, "_mesh_plan", None)
+    if plan is not None:
+        cm.data_axis_shards = max(1, int(plan.n_data_shards))
     return cm
 
 

@@ -120,7 +120,8 @@ def cmd_train(args) -> int:
         print(f"training UI on http://127.0.0.1:{ui_server.start()}/train")
     net.set_listeners(*listeners)
 
-    if args.workers > 1 or args.data_parallel:
+    if args.workers > 1:
+        # workers>1 keeps the facade for its minibatch-stacking semantics
         from deeplearning4j_tpu.parallel import (
             ParallelWrapper,
             data_parallel_mesh,
@@ -129,6 +130,8 @@ def cmd_train(args) -> int:
         ParallelWrapper(net, data_parallel_mesh(),
                         workers=args.workers).fit(it, epochs=args.epochs)
     else:
+        if args.data_parallel:
+            net.set_mesh()  # multi-device fit() would attach one anyway
         net.fit(it, epochs=args.epochs)
 
     if args.output:
@@ -685,6 +688,26 @@ def cmd_doctor(args) -> int:
         net = guess_and_load_model(args.model_path)
     else:
         net = _preset_network(args)
+    devices = getattr(args, "devices", None)
+    if devices and devices > 1:
+        # audit the SHARDED step signature: attach a data mesh over N
+        # devices (clamped to the platform) so the jaxpr trace and the
+        # JX006 donation check see exactly what a multi-chip fit builds
+        import jax as _jax
+
+        from deeplearning4j_tpu.parallel.mesh import data_parallel_mesh
+
+        avail = _jax.devices()
+        if len(avail) < devices:
+            print(f"doctor: --devices {devices} clamped to the "
+                  f"{len(avail)} visible device(s) (force more with "
+                  f"XLA_FLAGS=--xla_force_host_platform_device_count=N "
+                  f"on cpu)", file=sys.stderr)
+            devices = len(avail)
+        net._require_init()
+        net.set_mesh(data_parallel_mesh(avail[:devices]))
+        print(f"doctor: auditing the sharded train step over "
+              f"{net._mesh_plan.describe()}")
     findings = net.doctor(batch_size=args.batch, timesteps=args.timesteps,
                           jaxpr=not args.no_jaxpr)
     if args.json == "-":
@@ -1195,6 +1218,10 @@ def main(argv=None) -> int:
                    help="abstract batch size for the jaxpr audit")
     d.add_argument("--timesteps", type=int, default=8,
                    help="abstract sequence length for recurrent models")
+    d.add_argument("--devices", type=int, default=None, metavar="N",
+                   help="audit the sharded multi-chip step: attach a "
+                        "data mesh over N devices (clamped to the "
+                        "platform) before the jaxpr/donation audit")
     d.add_argument("--no-jaxpr", action="store_true",
                    help="config shapeflow only (skip the abstract trace)")
     d.add_argument("--json", default=None, metavar="PATH",

@@ -17,8 +17,8 @@ with async_prefetch=True):
   device or to a `NamedSharding` — in a background thread `depth` batches
   ahead, so host->device DMA overlaps the previous step's compute instead
   of sitting on the dispatch critical path. A `placement` callable (e.g.
-  parallel.ParallelWrapper's per-device shard function) replaces the
-  default device_put; a `transform` (data/transforms.DeviceBatchTransform)
+  a mesh-attached net's MeshPlan.shard_batch — parallel/sharded.py) replaces
+  the default device_put; a `transform` (data/transforms.DeviceBatchTransform)
   then runs on the already-device-resident batch. Batches come out marked
   `_pipeline_staged`, which tells the fit loop not to re-apply either.
 
@@ -98,7 +98,7 @@ def _ds_nbytes(ds) -> int:
 
 def _carry_metadata(src, dst):
     """Propagate the bookkeeping attributes a placement/transform must
-    not drop: pad-aware example counts (ParallelWrapper._shard_batch's
+    not drop: pad-aware example counts (the MeshPlan shard_batch's
     `reported_examples`) and the staged marker. Every stage that rebuilds
     a DataSet routes through here (transforms.py included) so new
     metadata has one place to live."""
@@ -324,9 +324,12 @@ class DevicePrefetchIterator(DataSetIterator):
     placement:
       * None — `jax.device_put` committed to `device` (default: the
         process default device) or to a NamedSharding passed as `device`.
-      * a callable ds->ds — a custom staging function; ParallelWrapper
-        installs its `_shard_batch` here, which is how sharding leaves
-        the dispatch critical path.
+      * a callable ds->ds — a custom staging function; a mesh-attached
+        net (set_mesh) installs its MeshPlan's `shard_batch` here, which
+        is how the per-shard batch split leaves the dispatch critical
+        path. shard_batch passes through arrays already committed with
+        the mesh sharding (zero-copy), so pre-staged batches are never
+        transferred twice.
     transform: an optional on-device batch transform (ds->ds, e.g.
       data/transforms.DeviceBatchTransform) applied AFTER placement — the
       per-pixel work runs as a jitted program on the accelerator, not in

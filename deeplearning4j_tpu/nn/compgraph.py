@@ -345,6 +345,10 @@ class ComputationGraph(NetworkBase):
         tmask = self._trainable_mask()
         updater = self.updater_def
         minimize = self.net_conf.minimize
+        # in-graph gradient all-reduce under a mesh plan — same pinning
+        # as MultiLayerNetwork._make_step_body (see the comment there)
+        plan = self._mesh_plan
+        gshard = None if plan is None else plan.grad_shardings(self)
 
         def step(params, states, upd_state, data, lr, t, rng):
             def loss_fn(p):
@@ -353,6 +357,8 @@ class ComputationGraph(NetworkBase):
             (score, new_states), grads = jax.value_and_grad(
                 loss_fn, has_aux=True
             )(params)
+            if gshard is not None:
+                grads = jax.lax.with_sharding_constraint(grads, gshard)
             if not minimize:
                 grads = jax.tree_util.tree_map(lambda g: -g, grads)
             grads = [
@@ -388,8 +394,7 @@ class ComputationGraph(NetworkBase):
             return body(params, states, upd_state,
                         (xs, ys, f_masks, l_masks), lr, t, rng)
 
-        donate = self._step_donate_argnums()
-        return jax.jit(step, donate_argnums=donate)
+        return self._jit_step(step, data_argnums=(3, 4, 5, 6))
 
     def _fit_step(self, xs, ys, f_masks, l_masks, stateful_states=None):
         if self._train_step_fn is None:
@@ -537,8 +542,9 @@ class ComputationGraph(NetworkBase):
                 (xs, ys, fms, lms, lrs, jnp.arange(K, dtype=jnp.uint32)))
             return params, states, upd_state, scores[-1]
 
-        donate = self._step_donate_argnums()
-        return jax.jit(step, donate_argnums=donate)
+        # stacked batches: [K, B, ...] — batch dim 1 shards over "data"
+        return self._jit_step(step, data_argnums=(3, 4, 5, 6),
+                              stacked_data=True)
 
     def _fit_tbptt(self, mds: MultiDataSet):
         """Truncated BPTT over a MultiDataSet: the time axis of every 3-d
@@ -691,8 +697,7 @@ class ComputationGraph(NetworkBase):
                 jnp.arange(1, n_seg))
             return params, states, upd_state, scores[-1]
 
-        donate = self._step_donate_argnums()
-        return jax.jit(step, donate_argnums=donate)
+        return self._jit_step(step)
 
     def _fit_tbptt_fused(self, mds: MultiDataSet, n_seg: int, seg: int,
                          bwd: int):
@@ -731,8 +736,7 @@ class ComputationGraph(NetworkBase):
             body = self._make_step_body(
                 self._trunc_loss_builder(),
                 collect=bool(getattr(self, "_collect_stats", False)))
-            donate = self._step_donate_argnums()
-            self._trunc_step_fn = jax.jit(body, donate_argnums=donate)
+            self._trunc_step_fn = self._jit_step(body)
             self._note_compile("train_step_truncated")
 
         lr = schedule_lr(self.net_conf, self.iteration)

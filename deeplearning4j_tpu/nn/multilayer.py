@@ -292,6 +292,13 @@ class MultiLayerNetwork(NetworkBase):
         tmask = self._trainable_mask()
         updater = self.updater_def
         minimize = self.net_conf.minimize
+        # mesh-attached nets pin the gradient reduction IN-GRAPH here:
+        # constraining the grads to the parameter shardings makes GSPMD
+        # insert the cross-device psum/mean at the grad site (replicated
+        # params x data-sharded batch), replacing the reference's
+        # host-side parameter averaging
+        plan = self._mesh_plan
+        gshard = None if plan is None else plan.grad_shardings(self)
 
         def step(params, states, upd_state, data, lr, t, rng):
             def loss_fn(p):
@@ -300,6 +307,8 @@ class MultiLayerNetwork(NetworkBase):
             (score, new_states), grads = jax.value_and_grad(
                 loss_fn, has_aux=True
             )(params)
+            if gshard is not None:
+                grads = jax.lax.with_sharding_constraint(grads, gshard)
             if not minimize:
                 grads = jax.tree_util.tree_map(lambda g: -g, grads)
             grads = [
@@ -329,12 +338,12 @@ class MultiLayerNetwork(NetworkBase):
 
     def _make_step(self, loss_builder):
         """Jitted single-minibatch optimizer step (donated params/updater
-        buffers on device backends)."""
+        buffers on device backends; sharded signature under a mesh plan —
+        see netbase._jit_step)."""
         step = self._make_step_body(
             loss_builder, collect=bool(getattr(self, "_collect_stats", False))
         )
-        donate = self._step_donate_argnums()
-        return jax.jit(step, donate_argnums=donate)
+        return self._jit_step(step)
 
     def _std_loss_builder(self):
         def loss_builder(p, states, data, rng):
@@ -467,8 +476,7 @@ class MultiLayerNetwork(NetworkBase):
             scores = jnp.concatenate([s0[None], scores])
             return params, states, upd_state, scores, last
 
-        donate = self._step_donate_argnums()
-        return jax.jit(step, donate_argnums=donate)
+        return self._jit_step(step)
 
     def _run_step(self, step_fn, data, stateful_states=None):
         lr = schedule_lr(self.net_conf, self.iteration)
@@ -859,8 +867,9 @@ class MultiLayerNetwork(NetworkBase):
                 (data_stack, lrs, jnp.arange(K, dtype=jnp.uint32)))
             return params, states, upd_state, scores[-1]
 
-        donate = self._step_donate_argnums()
-        return jax.jit(step, donate_argnums=donate)
+        # stacked batches: [K, B, ...] — under a mesh plan the batch dim
+        # (1, not 0) shards over the data axis
+        return self._jit_step(step, stacked_data=True)
 
     def _fit_std_batched(self, ds_list):
         K = len(ds_list)
@@ -952,8 +961,7 @@ class MultiLayerNetwork(NetworkBase):
                 jnp.arange(1, K))
             return params, states, upd_state, lasts[-1]
 
-        donate = self._step_donate_argnums()
-        return jax.jit(step, donate_argnums=donate)
+        return self._jit_step(step, stacked_data=True)
 
     def _fit_tbptt_batched(self, ds_list, n_seg: int, seg: int, bwd: int):
         K = len(ds_list)

@@ -54,11 +54,17 @@ class NetworkBase:
         self._collect_stats = False
         self._last_stats = None
         # hook applied to each DataSet before the step — installed by
-        # parallel.ParallelWrapper to shard the batch across the mesh.
-        # Under async_prefetch it runs inside the device-prefetch worker
-        # thread (off the dispatch critical path); staged batches carry
-        # `_pipeline_staged` so the loop never applies it twice
+        # set_mesh (the MeshPlan's shard_batch) to shard the batch across
+        # the mesh. Under async_prefetch it runs inside the device-prefetch
+        # worker thread (off the dispatch critical path); staged batches
+        # carry `_pipeline_staged` so the loop never applies it twice
         self._batch_transform = None
+        # the attached parallel.sharded.MeshPlan (set_mesh): params and
+        # updater state live on its mesh, batches shard on its "data"
+        # axis, and every step jit gets its NamedSharding in-shardings.
+        # None = single-device semantics. fit() auto-attaches one on
+        # multi-device platforms (DL4J_AUTO_MESH=0 disables).
+        self._mesh_plan = None
         # on-device batch transform (data/transforms.DeviceBatchTransform)
         # applied after placement — set_input_transform
         self._input_transform = None
@@ -164,6 +170,118 @@ class NetworkBase:
         donate = (0, 2) if jax.default_backend() != "cpu" else ()
         self._donate_argnums = donate
         return donate
+
+    def _jit_step(self, step, *, data_argnums=(3,), stacked_data=False):
+        """jit an optimizer-step body — the ONE place every step builder
+        (standard, truncated, fused-TBPTT, multi-batch; MultiLayerNetwork
+        and ComputationGraph) gets its jit, so the donation rule AND the
+        mesh sharding policy are single-sourced. Without a mesh plan
+        this is plain `jax.jit(step, donate_argnums=...)`; with one the
+        program is built with explicit NamedSharding in-shardings (batch
+        argnums sharded on the data axis, params/updater per their live
+        placement) and the same donation — the sharded signature JX006
+        audits via the recorded `_donate_argnums`."""
+        import jax
+
+        donate = self._step_donate_argnums()
+        plan = self._mesh_plan
+        if plan is None:
+            return jax.jit(step, donate_argnums=donate)
+        return plan.jit_step(self, step, donate_argnums=donate,
+                             data_argnums=data_argnums,
+                             stacked_data=stacked_data)
+
+    # -- multi-device mesh ----------------------------------------------------
+
+    def _reset_step_programs(self):
+        """Drop every cached jitted program (train steps, fused variants,
+        output cache) — placement or signature changed."""
+        self._train_step_fn = None
+        self._output_fn = None
+        for attr in ("_trunc_step_fn", "_fused_tbptt_fn", "_multi_fit_fn",
+                     "_tbptt_batched_fn"):
+            if hasattr(self, attr):
+                setattr(self, attr, None)
+
+    def set_mesh(self, mesh=None, *, plan=None):
+        """Attach a device mesh: the mainline multi-chip training path.
+        Params/layer state/updater state are committed to the mesh
+        replicated (tp/pp placements already on the mesh are honored),
+        each fit batch is sharded on the "data" axis by the input
+        pipeline, and the optimizer step compiles to ONE donated SPMD
+        program with the gradient all-reduce in-graph (see
+        parallel/sharded.py). `mesh=None` builds a 1-D "data" mesh over
+        all visible devices; `plan` overrides the MeshPlan (the
+        multi-host DCN plan does). `fit()` calls this automatically when
+        more than one device is visible (DL4J_AUTO_MESH=0 disables)."""
+        from deeplearning4j_tpu.parallel.sharded import MeshPlan
+
+        self._require_init()
+        if mesh is None:
+            from deeplearning4j_tpu.parallel.mesh import data_parallel_mesh
+
+            mesh = data_parallel_mesh()
+        if plan is None:
+            plan = MeshPlan(mesh)
+        plan.place_net(self)
+        self._mesh_plan = plan
+        self._batch_transform = plan.shard_batch
+        self._reset_step_programs()
+        return self
+
+    def unset_mesh(self):
+        """Detach the mesh plan (single-device semantics again). Params/
+        state/updater are re-committed to the default device: leaving
+        them committed to the multi-device mesh would hand the rebuilt
+        un-sharded jit arguments on incompatible device sets (mesh-
+        committed params vs default-device batches) — and the leftover
+        NamedSharding would also block auto-mesh from re-attaching."""
+        if self._mesh_plan is not None:
+            import jax
+
+            dev = jax.devices()[0]
+            put = lambda t: jax.tree_util.tree_map(
+                lambda a: jax.device_put(a, dev), t)
+            self.params_list = put(self.params_list)
+            self.state_list = put(self.state_list)
+            self.upd_state = put(self.upd_state)
+            self._mesh_plan = None
+            self._batch_transform = None
+            self._reset_step_programs()
+        return self
+
+    def _maybe_auto_mesh(self):
+        """The fit-loop default: on a multi-device platform with no mesh
+        attached and no caller-installed batch transform, engage the
+        sharded data-parallel step over all devices — multi-chip training
+        is the mainline, not an opt-in wrapper. DL4J_AUTO_MESH=0 opts a
+        process out (tests/conftest.py does, so tier-1's 8-virtual-device
+        suite doesn't shard every tiny fit)."""
+        if self._mesh_plan is not None or self._batch_transform is not None:
+            return
+        from deeplearning4j_tpu.parallel.sharded import auto_mesh_enabled
+
+        if not auto_mesh_enabled():
+            return
+        import jax
+
+        if len(jax.devices()) < 2:
+            return
+        if self.params_list is not None:
+            from jax.sharding import NamedSharding
+
+            for leaf in jax.tree_util.tree_leaves(self.params_list):
+                if isinstance(getattr(leaf, "sharding", None), NamedSharding):
+                    # params already carry a mesh placement (shard_params_tp
+                    # or an explicit set_mesh/unset_mesh sequence): that is
+                    # a deliberate parallelism decision — don't clobber it
+                    # with an auto data mesh
+                    return
+        logger.info(
+            "multi-device platform (%d devices): engaging the sharded "
+            "data-parallel train step (net.set_mesh; DL4J_AUTO_MESH=0 "
+            "disables)", len(jax.devices()))
+        self.set_mesh()
 
     # -- model FLOPs (the MFU numerator) -------------------------------------
 
@@ -361,6 +479,17 @@ class NetworkBase:
                     "fit batches whose example count could not be "
                     "determined (excluded from fit_examples_total — "
                     "an under-report made explicit, not silent)").labels(),
+                "allreduce_bytes": reg.counter(
+                    "allreduce_bytes_total",
+                    "gradient bytes all-reduced in-graph by the sharded "
+                    "train step (logical payload: summed gradient leaf "
+                    "bytes per optimizer step)").labels(),
+                "collective_seconds": reg.counter(
+                    "train_step_collective_seconds",
+                    "time attributed to the train step's gradient "
+                    "all-reduce, by accounting source (estimate = ring "
+                    "wire bytes / ICI bandwidth — a cost model, not a "
+                    "measurement)", ("source",)).labels("estimate"),
                 "recorder": _blackbox.get_recorder(),
                 "devprof": _devprof.get_profiler(),
             }
@@ -406,10 +535,19 @@ class NetworkBase:
                     jax.block_until_ready(self._score)
                 sync = time.perf_counter() - t1
                 ins["sync"].observe(sync)
-        ins["steps"].inc(max(1, self.iteration - it0))
+        n_steps = max(1, self.iteration - it0)
+        ins["steps"].inc(n_steps)
         ins["examples"].inc(n_examples)
         ins["data_wait"].observe(data_wait)
         ins["dispatch"].observe(dispatch)
+        # collective books: each sharded optimizer step all-reduced one
+        # gradient payload in-graph — scrape-able evidence the reduction
+        # runs on the interconnect, not through host averaging
+        plan = self._mesh_plan
+        if plan is not None and plan.n_data_shards > 1:
+            ins["allreduce_bytes"].inc(plan.grad_payload_bytes(self) * n_steps)
+            ins["collective_seconds"].inc(
+                plan.collective_seconds_estimate(self) * n_steps)
         # black box + liveness: one ring append (score kept as a device
         # reference — never synced here) and a heartbeat refresh
         ins["recorder"].record_step(self.iteration - 1, score=self._score,
@@ -442,6 +580,12 @@ class NetworkBase:
                  prefetch_buffer: int = 4,
                  hang_timeout: Optional[float] = None,
                  resume_from: Optional[str] = None):
+        # multi-device default: engage the sharded data-parallel step
+        # BEFORE restore/staging so the restored state lands on the mesh
+        # and the pipeline stages batches with the mesh sharding
+        self._maybe_auto_mesh()
+        if self._mesh_plan is not None:
+            self._mesh_plan.reset_pad_target()
         skip_batches = 0
         if resume_from is not None:
             # restore BEFORE staging: the iterator state lands on the
@@ -449,16 +593,28 @@ class NetworkBase:
             # composed around it
             skip_batches, epochs = self._restore_for_resume(
                 resume_from, iterator, epochs)
+            if self._mesh_plan is not None:
+                # checkpoint arrays arrive as host numpy: re-commit them
+                # to the mesh so the sharded step's in-shardings match
+                self._mesh_plan.place_net(self)
         owned = None
         if async_prefetch:
             staged = self._stage_input_pipeline(iterator, prefetch_buffer)
             if staged is not iterator:
                 iterator = owned = staged
+        # a caller-installed batch transform disables fusion (per-batch
+        # hooks must see their own batch) — EXCEPT the mesh plan's own
+        # shard_batch: sharded batches stack fine, and the stacked fused
+        # programs shard batch dim 1 (stacked_data in _jit_step), so
+        # mesh-attached nets keep their dispatch-fusion opt-in
+        plan_shard = (None if self._mesh_plan is None
+                      else self._mesh_plan.shard_batch)
         fuse_k = self._fused_k if (
             self._fused_k > 1
             and not self.listeners
             and not self._collect_stats
-            and self._batch_transform is None
+            and (self._batch_transform is None
+                 or self._batch_transform == plan_shard)
             and self._fused_fit_supported()
         ) else 1
         # liveness: the fit thread holds a busy slot on the "fit"
@@ -555,11 +711,11 @@ class NetworkBase:
           ParallelDataSetIterator multi-worker ETL) is kept; otherwise a
           single async host-prefetch thread is added (the pre-pipeline
           behavior).
-        * The device stage runs `_batch_transform` (ParallelWrapper's
-          per-device sharding) — or a committed default-device
-          `device_put` — plus the on-device input transform, all in its
-          worker thread, `_prefetch_depth` batches ahead: host->device
-          transfer leaves the dispatch critical path.
+        * The device stage runs `_batch_transform` (the mesh plan's
+          per-shard batch split under set_mesh) — or a committed
+          default-device `device_put` — plus the on-device input
+          transform, all in its worker thread, `_prefetch_depth` batches
+          ahead: host->device transfer leaves the dispatch critical path.
         """
         from deeplearning4j_tpu.data.prefetch import (
             DevicePrefetchIterator,
@@ -572,12 +728,12 @@ class NetworkBase:
             # untransformed (staged batches skip the inline application)
             for mine, theirs, what in (
                 (self._batch_transform, iterator.placement,
-                 "batch transform (ParallelWrapper sharding)"),
+                 "batch transform (mesh batch sharding)"),
                 (self._input_transform, iterator.transform,
                  "input transform"),
             ):
-                # `!=`, not `is not`: bound methods (ParallelWrapper's
-                # _shard_batch) are fresh objects per attribute access
+                # `!=`, not `is not`: bound methods (the MeshPlan's
+                # shard_batch) are fresh objects per attribute access
                 # but compare equal on (__self__, __func__)
                 if mine is not None and theirs != mine:
                     raise ValueError(

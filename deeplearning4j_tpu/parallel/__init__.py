@@ -20,6 +20,7 @@ from deeplearning4j_tpu.parallel.mesh import (
     n_devices,
     replicated,
 )
+from deeplearning4j_tpu.parallel.sharded import MeshPlan, auto_mesh_enabled
 from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
 from deeplearning4j_tpu.parallel.inference import (
     DeadlineExceeded,
@@ -49,6 +50,8 @@ __all__ = [
     "mesh_2d",
     "n_devices",
     "replicated",
+    "MeshPlan",
+    "auto_mesh_enabled",
     "ParallelWrapper",
     "ParallelInference",
     "ReplicaPool",

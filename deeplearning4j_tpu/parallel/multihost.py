@@ -37,6 +37,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from deeplearning4j_tpu.data.dataset import DataSet
 from deeplearning4j_tpu.parallel.mesh import DATA_AXIS
+from deeplearning4j_tpu.parallel.sharded import MeshPlan
 from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
 
 
@@ -59,46 +60,67 @@ def global_data_parallel_mesh() -> Mesh:
     return Mesh(np.array(jax.devices()), (DATA_AXIS,))
 
 
-class MultiHostDataParallel(ParallelWrapper):
-    """ParallelWrapper over a global (cross-process) mesh.
+class MultiHostMeshPlan(MeshPlan):
+    """MeshPlan over a global (cross-process) mesh.
 
-    The single-host wrapper's batch transform device_puts a host-local
-    numpy batch; across processes each host only HAS its own shard, so the
-    transform instead assembles the global array from per-process locals.
-    Every process must call fit with the same number of equally-shaped
-    local batches per epoch (the SPMD contract)."""
+    The single-host plan's batch split device_puts a host-local numpy
+    batch; across processes each host only HAS its own shard, so staging
+    instead assembles the global array from per-process locals
+    (host_local_array_to_global_array). Every process must fit the same
+    number of equally-shaped local batches per epoch (the SPMD
+    contract)."""
 
-    def _place_replicated(self):
+    def place_net(self, net) -> "MultiHostMeshPlan":
         """Replicate params/updater state across ALL processes' devices.
         Every process holds an identical copy (same-seed init or a
         restored checkpoint) — its local copy becomes the local shards of
         one global fully-replicated array."""
-        rep = lambda a: multihost_utils.host_local_array_to_global_array(
-            np.asarray(a), self.mesh, PartitionSpec())
-        put = lambda t: jax.tree_util.tree_map(rep, t)
-        self.model.params_list = put(self.model.params_list)
-        self.model.upd_state = put(self.model.upd_state)
+        def rep(a):
+            if a is None or self._on_this_mesh(a):
+                return a
+            return multihost_utils.host_local_array_to_global_array(
+                np.asarray(a), self.mesh, PartitionSpec())
 
-    def _shard_batch(self, ds):
+        put = lambda t: jax.tree_util.tree_map(rep, t)
+        net.params_list = put(net.params_list)
+        net.state_list = put(net.state_list)
+        net.upd_state = put(net.upd_state)
+        self._payload_bytes = None
+        return self
+
+    def shard_batch(self, ds):
         spec = PartitionSpec(DATA_AXIS)
 
         def to_global(a):
             if a is None:
                 return None
+            if self._on_this_mesh(a):
+                return a  # already assembled upstream
             return multihost_utils.host_local_array_to_global_array(
                 np.asarray(a), self.mesh, spec)
 
+        local_shards = self.n_data_shards // jax.process_count()
         n_local = ds.num_examples()
-        if n_local % (self.n_shards // jax.process_count()) != 0:
+        if n_local % local_shards != 0:
             raise ValueError(
                 f"local batch of {n_local} examples does not divide this "
-                f"process's {self.n_shards // jax.process_count()} shards; "
-                "pad locally (multi-host pad-and-mask must be applied "
-                "identically on every process)")
+                f"process's {local_shards} shards; pad locally "
+                "(multi-host pad-and-mask must be applied identically on "
+                "every process)")
         return DataSet(
             to_global(ds.features), to_global(ds.labels),
             to_global(ds.features_mask), to_global(ds.labels_mask),
         )
+
+
+class MultiHostDataParallel(ParallelWrapper):
+    """The ParallelWrapper facade over a global (cross-process) mesh —
+    NOT deprecated: it remains the multi-host bootstrap + data-assembly
+    entry point; the train step itself is the same mainline sharded
+    program (netbase.set_mesh with a MultiHostMeshPlan)."""
+
+    def _make_plan(self, mesh):
+        return MultiHostMeshPlan(mesh)
 
     def fit_local_shards(self, iterator, *, epochs: int = 1,
                          async_prefetch: bool = False):

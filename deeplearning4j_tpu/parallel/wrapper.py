@@ -1,4 +1,4 @@
-"""ParallelWrapper — single-node multi-device data-parallel training.
+"""ParallelWrapper — DEPRECATED facade over the mainline sharded step.
 
 Reference: deeplearning4j-scaleout/deeplearning4j-scaleout-parallelwrapper/
 src/main/java/org/deeplearning4j/parallelism/ParallelWrapper.java — N worker
@@ -6,50 +6,50 @@ threads each holding a full model replica, barrier every
 `averagingFrequency` iterations, then parameter + updater-state averaging
 across replicas (:417-424, :231-262).
 
-TPU-native design: there are no replicas and no averaging step. Parameters
-and updater state are *replicated* arrays on a `Mesh`; each global batch is
-*sharded* across the mesh's "data" axis; the jitted train step computes the
-global-mean loss, and XLA GSPMD inserts a gradient `psum` over ICI where
-the reference copied parameters between threads. Per-step gradient
-allreduce is mathematically ⊇ parameter averaging with frequency=1 when
-each "worker" contributes one shard of the global batch:
+There is nothing left for a wrapper to do: `fit()` itself now runs the
+single jitted, donated, NamedSharding data-parallel optimizer step over
+the device mesh (nn/netbase.set_mesh + parallel/sharded.MeshPlan), with
+the gradient all-reduce in-graph. Per-step gradient allreduce is
+mathematically ⊇ parameter averaging with frequency=1 when each "worker"
+contributes one shard of the global batch:
 
     averaged params = mean_i (θ - lr·g_i) = θ - lr·mean_i(g_i)
 
-which is exactly the allreduced-gradient step (asserted by
-tests/test_parallel.py::test_allreduce_equals_parameter_averaging). Higher
-averaging frequencies trade accuracy for communication that ICI does not
-need; they are intentionally not reproduced.
+(asserted by tests/test_parallel.py::test_allreduce_equals_parameter_
+averaging). Higher averaging frequencies trade accuracy for communication
+that ICI does not need; they are intentionally not reproduced.
 
-Training delegates to the model's own fit loop (epochs, listeners, TBPTT
-dispatch, ETL timing all single-sourced in MultiLayerNetwork.fit) with a
-batch-transform hook that shards each global batch onto the mesh; the
-wrapped model's params/updater state are placed replicated at construction,
-so after fit() the model is directly usable for inference/serialization.
+This class remains as a thin API-parity shim: construction attaches the
+mesh plan to the model (`model.set_mesh(mesh)`) and `fit()` delegates to
+the model's own fit loop — no per-interval host round-trip of parameters,
+no replicas, no averaging step. New code should call `net.set_mesh(mesh)`
+(or just `net.fit(...)`, which attaches a mesh automatically on
+multi-device platforms) and drop the wrapper. See MIGRATION.md.
 """
 
 from __future__ import annotations
 
 import logging
+import warnings
 
 import jax
 import numpy as np
 
-from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet
-from deeplearning4j_tpu.data.iterators import DataSetIterator, StackedDataSetIterator
+from deeplearning4j_tpu.data.iterators import (
+    DataSetIterator,
+    StackedDataSetIterator,
+)
 from deeplearning4j_tpu.parallel.mesh import (
     data_parallel_mesh,
-    data_shards,
     pad_wrap,
     placement_for_batch,
-    replicated,
 )
 
 logger = logging.getLogger("deeplearning4j_tpu")
 
 
 class ParallelWrapper:
-    """Data-parallel trainer over a device mesh.
+    """Deprecated data-parallel trainer facade (see module doc).
 
     Args:
         model: an initialized (or initializable) MultiLayerNetwork or
@@ -79,121 +79,49 @@ class ParallelWrapper:
                 "per-step ICI gradient allreduce used here is exact "
                 "averaging with frequency=1 (see parallel/wrapper.py doc)"
             )
+        if type(self) is ParallelWrapper:  # subclasses (multihost) are not
+            warnings.warn(
+                "ParallelWrapper is deprecated: fit() runs the sharded "
+                "data-parallel step itself on multi-device platforms — "
+                "call net.set_mesh(mesh) (or nothing at all) instead",
+                DeprecationWarning, stacklevel=2)
         self.model = model
         self.mesh = mesh if mesh is not None else data_parallel_mesh()
         self.workers = int(workers)
         self.prefetch_buffer = prefetch_buffer
-        self.n_shards = data_shards(self.mesh)
-        self._pad_target = 0  # largest shard-divisible batch seen
         model._require_init()
-        self._place_replicated()
+        # the whole former wrapper body — replicated placement, batch
+        # sharding, mesh-aware step jit — now lives on the net itself
+        model.set_mesh(self.mesh, plan=self._make_plan(self.mesh))
+        self.n_shards = model._mesh_plan.n_data_shards
 
-    # -- placement -----------------------------------------------------------
-
-    def _place_replicated(self):
-        """Commit params + updater state to the mesh, fully replicated —
-        the analog of ParallelWrapper copying the source model into every
-        worker replica (DefaultTrainer.java:193-221), done once instead of
-        per averaging round."""
-        rep = replicated(self.mesh)
-        put = lambda t: jax.tree_util.tree_map(
-            lambda a: jax.device_put(a, rep), t
-        )
-        self.model.params_list = put(self.model.params_list)
-        self.model.upd_state = put(self.model.upd_state)
-
-    def _shard_batch(self, ds):
-        """Shard a global batch's dim 0 across the data axis (DataSet or
-        MultiDataSet — ComputationGraph fit yields the latter).
-
-        Pad-and-mask tail handling: a batch not divisible by the shard
-        count is padded to the next multiple by WRAPPING examples (repeat
-        from the batch start) and the pad rows are excluded from the loss
-        via an all-zero labels-mask row (losses use masked_example_mean,
-        so the padded step computes exactly the unpadded score/gradients).
-        A labels mask of ones is supplied for full batches too, keeping
-        one trace signature — the tail batch neither recompiles nor drops
-        to replicated serial execution (round-2 weakness: a 255-example
-        tail on 8 devices ran 8x redundant AND recompiled). Note: wrapped
-        pad rows do still enter batch-norm batch statistics — a stochastic
-        duplicate-sample effect on the tail step only."""
-        n = ds.num_examples()
-        # pad up to the largest (shard-divisible) batch seen so far, so a
-        # short tail reuses the full batches' compiled executable instead
-        # of introducing a second shape
-        target = max(n + ((-n) % self.n_shards), self._pad_target)
-        self._pad_target = target
-        pad = target - n
-
-        def wrap(a):
-            return None if a is None else pad_wrap(np.asarray(a), target)
-
-        def pad_lmask(lm):
-            """Existing labels mask: pad rows of zeros. Absent: 0/1 vector."""
-            if lm is not None:
-                lm = np.asarray(lm)
-                z = np.zeros((pad,) + lm.shape[1:], lm.dtype)
-                return np.concatenate([lm, z]) if pad else lm
-            m = np.ones((n + pad,), np.float32)
-            if pad:
-                m[n:] = 0.0
-            return m
-
-        sh = placement_for_batch(self.mesh, n + pad)
-        put = lambda a: None if a is None else jax.device_put(a, sh)
-        if isinstance(ds, MultiDataSet):
-            lmasks = ds.labels_masks
-            if lmasks is None:
-                lmasks = [None] * len(ds.labels)
-            out = MultiDataSet(
-                [put(wrap(f)) for f in ds.features],
-                [put(wrap(l)) for l in ds.labels],
-                None if ds.features_masks is None
-                else [put(wrap(m)) for m in ds.features_masks],
-                [put(pad_lmask(m)) for m in lmasks],
-            )
-        else:
-            out = DataSet(
-                put(wrap(ds.features)),
-                put(wrap(ds.labels)),
-                put(wrap(ds.features_mask)),
-                put(pad_lmask(ds.labels_mask)),
-            )
-        # listeners/counters must see the REAL example count, not the pad
-        out.reported_examples = n
-        return out
+    def _make_plan(self, mesh):
+        """The MeshPlan to attach; None = the standard single-process
+        plan. MultiHostDataParallel overrides with the DCN plan."""
+        return None
 
     # -- training ------------------------------------------------------------
 
     def fit(self, data, labels=None, *, epochs: int = 1,
             batch_size: int = 128, async_prefetch: bool = True):
-        """Train data-parallel. Accepts the same inputs as
-        MultiLayerNetwork.fit; `batch_size` is the GLOBAL batch (sharded
-        across devices). With workers > 1 and an iterator input, each step
-        consumes `workers` minibatches as one global batch.
-
-        With async_prefetch, `_shard_batch` (pad + per-device
-        `device_put`) runs inside the device-prefetch worker thread
-        `prefetch_buffer`-deep ahead of the step (netbase's staged input
-        pipeline), so the shard split overlaps the previous step's
-        compute instead of sitting on the dispatch critical path."""
+        """Train data-parallel by delegating to the model's own sharded
+        fit loop. Accepts the same inputs as MultiLayerNetwork.fit;
+        `batch_size` is the GLOBAL batch (sharded across devices). With
+        workers > 1 and an iterator input, each step consumes `workers`
+        minibatches as one global batch. The model keeps its mesh plan
+        after this call — it IS a sharded net now, not a wrapped one."""
         net = self.model
         data_in = data
         if self.workers > 1:
             if not isinstance(data, DataSetIterator):
                 raise ValueError("workers > 1 requires a DataSetIterator input")
             data_in = StackedDataSetIterator(data, self.workers)
-        # the pad-up-to target is per-fit state: a later fit with a smaller
-        # batch size must not keep padding to the old larger shape
-        self._pad_target = 0
-        prev_transform = net._batch_transform
-        net._batch_transform = self._shard_batch
-        try:
-            net.fit(data_in, labels, epochs=epochs, batch_size=batch_size,
-                    async_prefetch=async_prefetch,
-                    prefetch_buffer=self.prefetch_buffer)
-        finally:
-            net._batch_transform = prev_transform
+        if net._mesh_plan is None or net._mesh_plan.mesh is not self.mesh:
+            # re-attach after an unset_mesh
+            net.set_mesh(self.mesh, plan=self._make_plan(self.mesh))
+        net.fit(data_in, labels, epochs=epochs, batch_size=batch_size,
+                async_prefetch=async_prefetch,
+                prefetch_buffer=self.prefetch_buffer)
         return net
 
     # -- sharded inference ---------------------------------------------------

@@ -198,7 +198,12 @@ class DeviceProfiler:
                 else max(1, st["dispatches"])
             ins["step_seconds"].labels().set(dt / steps)
             if per_example:
-                fps = per_example * st["examples"] / dt
+                # PER-CHIP accounting: a mesh-attached net consumes the
+                # global batch across n data shards, so the model FLOP/s
+                # divide by n before meeting the per-chip peak —
+                # otherwise multi-chip MFU over-reports n×
+                n_chips = _data_shards_of(net)
+                fps = per_example * st["examples"] / dt / n_chips
                 from deeplearning4j_tpu.utils.flops import (
                     peak_flops_per_chip,
                 )
@@ -231,7 +236,11 @@ class DeviceProfiler:
             out["updater"] = st.get("updater_bytes", 0)
             attached = getattr(net, "_cost_model_meta", None)
             if attached and attached.get("activation_peak_bytes"):
-                out["activations_est"] = attached["activation_peak_bytes"]
+                # activations are batch-sharded on a mesh-attached net:
+                # the per-chip estimate divides by the data-axis size
+                out["activations_est"] = (
+                    attached["activation_peak_bytes"]
+                    // _data_shards_of(net))
         live = device_bytes_in_use()
         if live is not None:
             out["live"] = live
@@ -304,13 +313,37 @@ class DeviceProfiler:
             return None
 
 
+def _data_shards_of(net) -> int:
+    """Data-axis shard count of a mesh-attached net (1 otherwise) —
+    the divisor that keeps every per-chip number per-chip."""
+    plan = getattr(net, "_mesh_plan", None)
+    n = getattr(plan, "n_data_shards", 1) if plan is not None else 1
+    return max(1, int(n))
+
+
 def _tree_bytes(tree) -> int:
+    """PER-CHIP byte sum of a pytree: sharded leaves (a tp split, a
+    data-sharded batch) count their per-device shard, replicated leaves
+    their full size — `device_memory_bytes{kind}` is a single chip's
+    watermark, not the global footprint."""
     total = 0
     try:
         for leaf in _jax().tree_util.tree_leaves(tree):
             nb = getattr(leaf, "nbytes", None)
-            if nb is not None:
-                total += int(nb)
+            if nb is None:
+                continue
+            nb = int(nb)
+            sh = getattr(leaf, "sharding", None)
+            if sh is not None:
+                try:
+                    shard = sh.shard_shape(leaf.shape)
+                    size = 1
+                    for s in shard:
+                        size *= int(s)
+                    nb = size * int(leaf.dtype.itemsize)
+                except Exception:
+                    pass
+            total += nb
     except Exception:
         return 0
     return total

@@ -227,6 +227,19 @@ TPU_HBM_BANDWIDTH = {
     "v6e": 1640e9,
 }
 
+# Aggregate inter-chip (ICI) bandwidth per chip — the denominator of the
+# `train_step_collective_seconds{source="estimate"}` gradient-allreduce
+# cost model (parallel/sharded.MeshPlan). Approximate public figures for
+# all links of one chip combined; an estimate's denominator, clearly
+# labeled as such wherever it surfaces.
+TPU_ICI_BANDWIDTH = {
+    "v5e": 200e9,
+    "v5litepod": 200e9,
+    "v4": 300e9,
+    "v5p": 600e9,
+    "v6e": 448e9,
+}
+
 
 def _chip_lookup(table: dict, env_var: str, default):
     import os
@@ -263,3 +276,10 @@ def hbm_bandwidth_per_chip(default: float = 819e9) -> float:
     """HBM bandwidth of the current chip (roofline ridge); the v5e
     figure stands in off-TPU — the roofline is a TPU-shaped model."""
     return _chip_lookup(TPU_HBM_BANDWIDTH, "BENCH_HBM_BANDWIDTH", default)
+
+
+def ici_bandwidth_per_chip(default: float = 200e9) -> float:
+    """Aggregate ICI bandwidth of the current chip — the gradient
+    all-reduce estimate's denominator; the v5e figure stands in off-TPU
+    (the estimate is a TPU-shaped cost model, labeled `estimate`)."""
+    return _chip_lookup(TPU_ICI_BANDWIDTH, "BENCH_ICI_BANDWIDTH", default)

@@ -24,6 +24,26 @@ cd "$(dirname "$0")/.."
 # the old inline print-grep guard (print is finding code CC006).
 bash scripts/lint.sh || exit 1
 
+# -- 2-simulated-device sharding smoke ---------------------------------------
+# The mainline multi-chip fit() path — auto-attached mesh, in-graph
+# gradient all-reduce, sharded == single-device numerics — exercised
+# under a forced 2-device CPU platform with the PRODUCTION default
+# DL4J_AUTO_MESH=1 (the main suite below runs with auto-mesh off so its
+# hundreds of single-device fits don't each compile an 8-way SPMD
+# program). A separate interpreter because the device count is fixed at
+# backend init.
+rm -f /tmp/_t1_sharding.log
+if timeout -k 10 240 env JAX_PLATFORMS=cpu DL4J_AUTO_MESH=1 \
+    XLA_FLAGS="--xla_force_host_platform_device_count=2" \
+    python -m pytest tests/test_sharded_step.py -q -m 'not slow' -k smoke \
+    -p no:cacheprovider > /tmp/_t1_sharding.log 2>&1; then
+    echo "T1 SHARDING SMOKE: ok (2 simulated devices, auto-mesh fit)"
+else
+    echo "T1 SHARDING SMOKE: FAILED — tail of /tmp/_t1_sharding.log:"
+    tail -20 /tmp/_t1_sharding.log
+    exit 1
+fi
+
 # -- the canonical tier-1 pytest run -----------------------------------------
 # T1_METRICS_DUMP=1 makes tests/conftest.py write the shared metrics
 # registry's snapshot after the session (T1_METRICS_ARTIFACT, default

@@ -41,6 +41,17 @@ if _bb_artifact:
 
     install_crash_hooks(_bb_artifact)
 
+# Auto-mesh OFF by default under the suite (same discipline as the
+# devprof line below): the virtual platform above exposes 8 devices, so
+# fit()'s mainline multi-device default would otherwise compile an
+# 8-way SPMD program for every tiny fit in the suite — slow on a 2-core
+# box and a behavior change under hundreds of single-device numeric
+# tests. The dedicated sharding tests opt in (set_mesh / monkeypatch),
+# and scripts/t1.sh runs the 2-simulated-device AUTO-mesh smoke in its
+# own interpreter with DL4J_AUTO_MESH=1. setdefault, not assignment, so
+# that smoke run's explicit =1 wins.
+os.environ.setdefault("DL4J_AUTO_MESH", "0")
+
 # Device-profiler sampling OFF under tier-1 (utils/devprof): the sampled
 # block_until_ready would add timing jitter to every fit-heavy test on a
 # loaded CI box. Tests that exercise the sampler configure it locally

@@ -299,18 +299,18 @@ def test_user_prefetch_iterator_must_carry_net_transforms():
     _assert_no_pipeline_threads()
 
 
-def test_user_prefetch_with_wrapper_sharding_accepted():
+def test_user_prefetch_with_mesh_sharding_accepted():
     """The error message's own advice must work: a caller-built pipeline
-    whose placement is the wrapper's shard function is accepted (bound
+    whose placement is the mesh plan's shard function is accepted (bound
     methods are fresh objects per access — equality, not identity)."""
-    from deeplearning4j_tpu.parallel import ParallelWrapper, data_parallel_mesh
+    from deeplearning4j_tpu.parallel import data_parallel_mesh
 
     net = _toy_net()
-    pw = ParallelWrapper(net, data_parallel_mesh())
+    net.set_mesh(data_parallel_mesh())
     it = DevicePrefetchIterator(
         ListDataSetIterator(_toy_dataset(n=32), 16),
-        placement=pw._shard_batch)
-    pw.fit(it, epochs=1)
+        placement=net._mesh_plan.shard_batch)
+    net.fit(it, epochs=1)
     assert net.iteration == 2
     _assert_no_pipeline_threads()
 
