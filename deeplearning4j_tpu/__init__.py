@@ -39,12 +39,17 @@ def configure_logging(level=_logging.INFO, json_lines: bool = False,
     """Opt-in log output for applications and CLIs.
 
     Plain mode attaches a conventional stderr handler. `json_lines=True`
-    emits one JSON object per record (ts/level/logger/message) so log
-    aggregators get structured records without a parsing layer. Calling
-    again replaces the handler installed by the previous call (idempotent
-    — safe from notebooks/REPLs)."""
+    emits one JSON object per record (ts/level/logger/message, plus
+    `trace_id`/`span_id` — the active distributed-tracing context when a
+    span is open on the logging thread, empty strings otherwise — so one
+    trace id greps across logs, span exports, flight-recorder dumps and
+    histogram exemplars) so log aggregators get structured records
+    without a parsing layer. Calling again replaces the handler installed
+    by the previous call (idempotent — safe from notebooks/REPLs)."""
     import json as _json
     import time as _time
+
+    from deeplearning4j_tpu.utils import tracing as _tracing
 
     logger = _logging.getLogger("deeplearning4j_tpu")
     for h in list(logger.handlers):
@@ -54,6 +59,9 @@ def configure_logging(level=_logging.INFO, json_lines: bool = False,
     if json_lines:
         class _JsonFormatter(_logging.Formatter):
             def format(self, record):
+                # format() runs on the emitting thread, so the active
+                # span context here IS the one the message belongs to
+                ctx = _tracing.current_context()
                 doc = {
                     "ts": round(record.created, 3),
                     "iso": _time.strftime(
@@ -62,6 +70,9 @@ def configure_logging(level=_logging.INFO, json_lines: bool = False,
                     "level": record.levelname,
                     "logger": record.name,
                     "message": record.getMessage(),
+                    "trace_id": ctx.trace_id if ctx is not None else "",
+                    "span_id": (format(ctx.span_id, "016x")
+                                if ctx is not None else ""),
                 }
                 if record.exc_info:
                     doc["exc"] = self.formatException(record.exc_info)

@@ -591,6 +591,52 @@ def _metrics_watch(args) -> int:
     return 0
 
 
+def cmd_trace(args) -> int:
+    """Distributed-trace readout (analysis/tracecrit): reconstruct span
+    trees from a JSONL export — a file written by TracingListener /
+    `cli chaos --trace-out`, or a live server's GET /trace — and report
+    the top-k slowest traces with critical path and per-stage self-time
+    breakdown. `--trace-id` resolves one specific trace (paste a
+    histogram exemplar's trace_id from GET /metrics); exit 1 when it
+    (or any trace at all) is missing from the export."""
+    import json as _json
+    import os
+
+    from deeplearning4j_tpu.analysis import tracecrit
+
+    src = args.source
+    if src.startswith(("http://", "https://")):
+        import urllib.request
+
+        url = src if "/trace" in src.split("://", 1)[1] \
+            else src.rstrip("/") + "/trace"
+        with urllib.request.urlopen(url, timeout=args.timeout) as r:
+            text = r.read().decode()
+    else:
+        if not os.path.exists(src):
+            print(f"trace export not found: {src}", file=sys.stderr)
+            return 2
+        with open(src) as f:
+            text = f.read()
+    events = tracecrit.parse_jsonl(text)
+    report = tracecrit.analyze(events, top=args.top,
+                               trace_id=args.trace_id)
+    if args.json == "-":
+        print(_json.dumps(report, indent=2))
+    elif args.json:
+        with open(args.json, "w") as f:
+            _json.dump(report, f, indent=2)
+        print(f"wrote {args.json}")
+    else:
+        print(tracecrit.format_report(report))
+    if not report["traces"]:
+        print("no matching trace in the export "
+              "(tracing off, ring aged out, or wrong --trace-id?)",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
 def cmd_blackbox(args) -> int:
     """Render a flight-recorder crash dump (utils/blackbox — written by
     install_crash_hooks on SIGTERM/fatal error, by the watchdog on a
@@ -840,6 +886,13 @@ def _chaos_serving(plan, requests: int, clients: int,
     wedged = []
     try:
         pi.warmup((n_in,))
+        # under --trace-out, tracing was enabled before warmup: drop the
+        # per-bucket compile forwards from the ring, or they dominate the
+        # export's slowest-traces report as standalone warmup noise
+        from deeplearning4j_tpu.utils import tracing as _tracing
+
+        if _tracing.is_enabled():
+            _tracing.get_tracer().clear()
         with fp.active(plan):
             threads = [threading.Thread(target=client, args=(i,),
                                         daemon=True,
@@ -952,18 +1005,44 @@ def _chaos_default_plan(preset: str, seed: int):
             .add("device_put", "latency", p=0.1, latency_ms=5.0))
 
 
+def _chaos_trace_report(preset: str, path: str) -> dict:
+    """Write the run's span export and — for the serving preset — check
+    the fault-to-trace linkage: every injected fault's marker must sit
+    in a trace that also carries serve/* lifecycle spans, i.e. a chaos
+    fault is attributable to the concrete request it hit."""
+    from deeplearning4j_tpu.analysis import tracecrit
+    from deeplearning4j_tpu.utils import tracing as _tracing
+
+    tracer = _tracing.get_tracer()
+    events = tracer.recent()
+    tracer.write_jsonl(path)
+    traces = tracecrit.group_traces(events)
+    faults = [e for e in events if e.get("name") == "fault/injected"]
+    linked = sum(
+        1 for ev in faults
+        if any(e.get("name", "").startswith("serve/")
+               for e in traces.get(ev.get("trace"), [])))
+    out = {"path": path, "fault_spans": len(faults)}
+    if preset == "serving":
+        out["fault_spans_linked"] = linked
+        out["fault_trace_ok"] = linked == len(faults)
+    return out
+
+
 def cmd_chaos(args) -> int:
     """Replay a seeded FaultPlan outside pytest (utils/faultpoints): run
     the serving or training preset workload under the plan and report
     the canonical event log plus the invariant verdict. Exit 0 when the
     run ends recovered or cleanly failed with the serving books
     balanced; 1 when an invariant broke (a wedge, a conservation
-    violation, a component left unhealthy). Two runs of the same plan
-    + preset produce the same event log — diff the --json artifacts to
-    prove a replay."""
+    violation, a component left unhealthy, or — with --trace-out on the
+    serving preset — an injected fault whose trace lacks the request's
+    lifecycle spans). Two runs of the same plan + preset produce the
+    same event log — diff the --json artifacts to prove a replay."""
     import json as _json
 
     from deeplearning4j_tpu.utils import faultpoints as fp
+    from deeplearning4j_tpu.utils import tracing as _tracing
 
     if args.plan:
         with open(args.plan) as f:
@@ -972,11 +1051,20 @@ def cmd_chaos(args) -> int:
             plan.seed = int(args.seed)
     else:
         plan = _chaos_default_plan(args.preset, args.seed or 0)
-    if args.preset == "serving":
-        report = _chaos_serving(plan, args.requests, args.clients,
-                                args.deadline_ms)
-    else:
-        report = _chaos_training(plan, args.steps)
+    trace_out = args.trace_out
+    if trace_out:
+        prev_tracing = _tracing.is_enabled()
+        _tracing.get_tracer().clear()
+        _tracing.enable(True)
+    try:
+        if args.preset == "serving":
+            report = _chaos_serving(plan, args.requests, args.clients,
+                                    args.deadline_ms)
+        else:
+            report = _chaos_training(plan, args.steps)
+    finally:
+        if trace_out:
+            _tracing.enable(prev_tracing)
     report = {
         "preset": args.preset,
         "plan": _json.loads(plan.to_json()),
@@ -984,9 +1072,12 @@ def cmd_chaos(args) -> int:
         "invocations": plan.invocations(),
         **report,
     }
+    if trace_out:
+        report["trace"] = _chaos_trace_report(args.preset, trace_out)
     ok = (report["outcome"] in ("recovered", "cleanly_failed")
           and report["conservation_ok"]
-          and not report["unhealthy_components"])
+          and not report["unhealthy_components"]
+          and report.get("trace", {}).get("fault_trace_ok", True))
     report["verdict"] = "ok" if ok else "violated"
     if args.json == "-":
         print(_json.dumps(report, indent=2, default=str))
@@ -1010,6 +1101,13 @@ def cmd_chaos(args) -> int:
                   f"(conserved: {report['conservation_ok']})")
         if report.get("failure"):
             print(f"  failure: {report['failure']}")
+        if report.get("trace"):
+            tr = report["trace"]
+            print(f"  trace export: {tr['path']} "
+                  f"({tr['fault_spans']} fault span(s)"
+                  + (f", {tr.get('fault_spans_linked')} linked to request "
+                     f"traces" if "fault_trace_ok" in tr else "")
+                  + ")")
         print(f"  outcome: {report['outcome']}  "
               f"verdict: {report['verdict']}")
     return 0 if ok else 1
@@ -1175,6 +1273,26 @@ def main(argv=None) -> int:
                    help="stop after N watch ticks (0 = until ctrl-C)")
     m.set_defaults(fn=cmd_metrics)
 
+    tr = sub.add_parser(
+        "trace",
+        help="distributed-trace readout: span trees, critical path and "
+             "per-stage breakdown from a JSONL export or a live server's "
+             "GET /trace (analysis/tracecrit)")
+    tr.add_argument("source",
+                    help="JSONL span export file, or a server base URL "
+                         "(e.g. http://127.0.0.1:9100 — /trace is "
+                         "appended)")
+    tr.add_argument("--top", type=int, default=5,
+                    help="how many of the slowest traces to report")
+    tr.add_argument("--trace-id", default=None,
+                    help="resolve one specific trace (accepts a unique "
+                         "prefix) — paste a histogram exemplar's "
+                         "trace_id from GET /metrics")
+    tr.add_argument("--timeout", type=float, default=10.0)
+    tr.add_argument("--json", default=None, metavar="PATH",
+                    help="machine-readable report ('-' = stdout)")
+    tr.set_defaults(fn=cmd_trace)
+
     bb = sub.add_parser(
         "blackbox",
         help="render a flight-recorder crash dump (final-steps timeline, "
@@ -1252,6 +1370,11 @@ def main(argv=None) -> int:
     ch.add_argument("--json", default=None, metavar="PATH",
                     help="machine-readable report ('-' = stdout) — diff "
                          "two runs' `events` to prove a replay")
+    ch.add_argument("--trace-out", default=None, metavar="PATH",
+                    help="run with tracing on and write the span export "
+                         "(JSONL) here; the serving preset additionally "
+                         "gates on every injected fault being linked to "
+                         "a request trace (render with `cli trace`)")
     ch.set_defaults(fn=cmd_chaos)
 
     ln = sub.add_parser(

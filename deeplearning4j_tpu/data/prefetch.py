@@ -51,6 +51,7 @@ from deeplearning4j_tpu.data.iterators import (
 from deeplearning4j_tpu.utils import faultpoints as _faults
 from deeplearning4j_tpu.utils import health as _health
 from deeplearning4j_tpu.utils import metrics as _metrics
+from deeplearning4j_tpu.utils import tracing as _tracing
 
 _DONE = object()  # one per ETL worker: "this worker's stream is finished"
 
@@ -175,6 +176,10 @@ class ParallelDataSetIterator(DataSetIterator):
         q: "queue.Queue" = queue.Queue(maxsize=self.queue_size)
         stop = threading.Event()
         ins = self._ins
+        # span context captured on the CONSUMER thread: workers attach it
+        # so anything they record (fault markers, future spans) parents
+        # into the trace that is iterating, not a fresh per-worker root
+        trace_ctx = _tracing.current_context()
         # ONE heartbeat shared by all workers: each holds a busy slot
         # while it owns an item (base pull + transform); the component
         # stalls when the OLDEST slot goes stale, so one wedged worker
@@ -224,6 +229,7 @@ class ParallelDataSetIterator(DataSetIterator):
                 ins["bytes"].inc(_ds_nbytes(out))
 
         def worker_main():
+            _tracing.attach(trace_ctx)  # thread-local; dies with the thread
             try:
                 worker()
             finally:
@@ -395,6 +401,10 @@ class DevicePrefetchIterator(DataSetIterator):
         ins = self._ins
         sentinel = self._sentinel
         target = self._resolve_target()
+        # consumer-thread span context, attached by the worker below: the
+        # prefetch handoff keeps parentage — staging spans land in the
+        # iterating trace instead of silently starting new roots
+        trace_ctx = _tracing.current_context()
 
         # liveness: busy while an item is in hand (base pull + staging —
         # a wedged upstream iterator or a device_put that never returns
@@ -404,6 +414,7 @@ class DevicePrefetchIterator(DataSetIterator):
             self.stage, stall_after=self.health_stall_after)
 
         def worker():
+            _tracing.attach(trace_ctx)  # thread-local; dies with the thread
             try:
                 it = iter(self.base)
                 while True:
@@ -413,7 +424,9 @@ class DevicePrefetchIterator(DataSetIterator):
                         except StopIteration:
                             return
                         nb = _ds_nbytes(ds)  # host bytes, before staging
-                        staged = self._stage(ds, target)
+                        with _tracing.span("prefetch/stage",
+                                           stage=self.stage):
+                            staged = self._stage(ds, target)
                     t0 = time.perf_counter()
                     if not _put_abortable(q, staged, stop):
                         return

@@ -48,6 +48,17 @@ serving-specific mechanisms go beyond it:
   `admitted == completed + shed + failed` (rejections happen before
   admission and are counted separately) — tests/test_chaos.py asserts
   it under injected faults.
+
+* **Request lifecycle tracing** — with tracing on (utils/tracing), every
+  request is one trace: a `serve/admission` span on the caller's thread
+  whose SpanContext rides the queue item and the handoff tuple, so the
+  collector's retroactive `serve/queued` span, the dispatcher's
+  `serve/dispatch` → `serve/forward` spans, and every `serve/shed`
+  marker (tagged {stage, reason} like serving_shed_total) keep their
+  parentage across the pipeline threads. Fused groups attach the first
+  live member's context for the real spans and record per-member
+  retroactive copies, so each request's trace is complete. Off by
+  default; every hook is one flag check when disabled.
 """
 
 from __future__ import annotations
@@ -160,6 +171,25 @@ def _queue_depth(ref) -> int:
     if pi is None:
         return 0
     return pi._q.qsize() + pi._handoff.qsize()
+
+
+def _trace_shed_span(stage: str, reason: str,
+                     ctx: Optional[_tracing.SpanContext] = None):
+    """Record a zero-duration serve/shed span tagged {stage, reason}
+    (mirroring serving_shed_total's labels) under the request's context —
+    ctx when the shed happens on a pipeline thread, the current context
+    when it happens on the caller's. The ONE place the shed-span shape
+    lives: ParallelInference stages and ReplicaPool resubmit sheds both
+    record through it. One flag check when tracing is off."""
+    if not _tracing.is_enabled():
+        return
+    if ctx is None:
+        ctx = _tracing.current_context()
+    if ctx is None:
+        return
+    now = time.perf_counter()
+    _tracing.record_complete("serve/shed", now, now, ctx,
+                             stage=stage, reason=reason)
 
 
 def power_of_two_buckets(max_batch_size: int) -> List[int]:
@@ -370,6 +400,74 @@ class ParallelInference:
                 f"deadline_ms must be finite, got {deadline_ms!r}")
         deadline = (None if deadline_ms is None
                     else time.monotonic() + float(deadline_ms) / 1e3)
+        # the request's lifecycle root below the caller's span: the
+        # admission decision runs inside it, and its context rides the
+        # queue item so every downstream stage (queued/dispatch/forward/
+        # shed) parents here even when completed on a pipeline thread.
+        # Disabled path: NULL_SPAN + None ctx after one flag check each.
+        adm_span = _tracing.span("serve/admission", rows=int(xx.shape[0]))
+        with adm_span:
+            fut, ctx = self._admit(xx, deadline)
+        if fut is not None:
+            if deadline is None:
+                return fut.result()
+            # bounded wait: the collector/dispatcher are the PRIMARY
+            # shedders (they see the expiry first while the pipeline is
+            # alive, and their skip saves the device work) — but when
+            # the pipeline itself wedges nothing downstream will ever
+            # touch the future, so after a short grace past the deadline
+            # the waiter sheds it here. _fail is race-safe: a concurrent
+            # resolve/shed that beat us wins and is what the caller gets
+            try:
+                return fut.result(
+                    timeout=max(0.0, deadline - time.monotonic())
+                    + _WAIT_SHED_GRACE)
+            except FutureTimeoutError:
+                exc = DeadlineExceeded(
+                    "deadline expired waiting on a stalled pipeline",
+                    stage="wait")
+                if self._fail(fut, exc, outcome="shed", stage="wait",
+                              reason="expired"):
+                    self._trace_shed("wait", "expired", ctx)
+                    raise exc from None
+                return fut.result()
+        # SEQUENTIAL mode, or an oversized request: run it alone instead of
+        # overshooting a fused group arbitrarily (device work off-lock).
+        # The unfused path honors the deadline like the fused one does:
+        # expired before the forward = dispatch-stage shed (saves the
+        # device work); finished past deadline + grace = wait-stage shed
+        # (the fused waiter's backstop — a late result is never served)
+        if deadline is not None and time.monotonic() >= deadline:
+            self._count_outcome("shed", stage="dispatch", reason="expired")
+            self._trace_shed("dispatch", "expired", ctx)
+            raise DeadlineExceeded(
+                "deadline expired before the unfused forward",
+                stage="dispatch")
+        try:
+            with _tracing.attached_ctx(ctx):
+                out = self._run(xx)
+        except BaseException:
+            self._count_outcome("failed")
+            raise
+        if deadline is not None \
+                and time.monotonic() >= deadline + _WAIT_SHED_GRACE:
+            self._count_outcome("shed", stage="wait", reason="expired")
+            self._trace_shed("wait", "expired", ctx)
+            raise DeadlineExceeded(
+                "deadline expired during the unfused forward",
+                stage="wait")
+        self._count_outcome("completed")
+        return out
+
+    def _admit(self, xx: np.ndarray, deadline: Optional[float]):
+        """Validation + admission control + (for fusable requests) the
+        enqueue, all under ONE lock hold. Returns (future, span_context):
+        the future is None for requests that must run unfused on the
+        caller's thread; the context is the serve/admission span's (the
+        caller opens it around this call) — it rides the queue item so
+        downstream lifecycle spans keep parentage across the pipeline
+        threads, and is None when tracing is off."""
+        ctx = _tracing.current_context()
         with self._lock:
             # shutdown check and enqueue under ONE lock: a request admitted
             # here is visible to shutdown()'s drain, so its Future always
@@ -404,6 +502,7 @@ class ParallelInference:
             now = time.monotonic()
             if deadline is not None and now >= deadline:
                 self._shed_locked("admission", "expired")
+                self._trace_shed("admission", "expired", ctx)
                 raise DeadlineExceeded(
                     "deadline expired before admission",
                     stage="admission")
@@ -422,6 +521,7 @@ class ParallelInference:
             if fusable and self.queue_capacity \
                     and self._q.qsize() >= self.queue_capacity:
                 self._shed_locked("admission", "queue_full")
+                self._trace_shed("admission", "queue_full", ctx)
                 raise RequestRejected(
                     f"request queue at capacity "
                     f"({self.queue_capacity} requests)",
@@ -430,6 +530,7 @@ class ParallelInference:
                     and now + est_wait > deadline:
                 if not self._estimator_stale_locked(now, p50):
                     self._shed_locked("admission", "predicted_late")
+                    self._trace_shed("admission", "predicted_late", ctx)
                     raise RequestRejected(
                         f"estimated wait {est_wait * 1e3:.0f}ms exceeds "
                         f"the request's remaining deadline "
@@ -451,54 +552,13 @@ class ParallelInference:
                 self._queued_examples += xx.shape[0]
                 # put_nowait: the queue OBJECT is unbounded (the capacity
                 # bound is the admission check above), so this is exactly
-                # `put` — minus the lint-rejected blocking form
-                self._q.put_nowait((xx, fut, deadline))
-        if fut is not None:
-            if deadline is None:
-                return fut.result()
-            # bounded wait: the collector/dispatcher are the PRIMARY
-            # shedders (they see the expiry first while the pipeline is
-            # alive, and their skip saves the device work) — but when
-            # the pipeline itself wedges nothing downstream will ever
-            # touch the future, so after a short grace past the deadline
-            # the waiter sheds it here. _fail is race-safe: a concurrent
-            # resolve/shed that beat us wins and is what the caller gets
-            try:
-                return fut.result(
-                    timeout=max(0.0, deadline - time.monotonic())
-                    + _WAIT_SHED_GRACE)
-            except FutureTimeoutError:
-                exc = DeadlineExceeded(
-                    "deadline expired waiting on a stalled pipeline",
-                    stage="wait")
-                if self._fail(fut, exc, outcome="shed", stage="wait",
-                              reason="expired"):
-                    raise exc from None
-                return fut.result()
-        # SEQUENTIAL mode, or an oversized request: run it alone instead of
-        # overshooting a fused group arbitrarily (device work off-lock).
-        # The unfused path honors the deadline like the fused one does:
-        # expired before the forward = dispatch-stage shed (saves the
-        # device work); finished past deadline + grace = wait-stage shed
-        # (the fused waiter's backstop — a late result is never served)
-        if deadline is not None and time.monotonic() >= deadline:
-            self._count_outcome("shed", stage="dispatch", reason="expired")
-            raise DeadlineExceeded(
-                "deadline expired before the unfused forward",
-                stage="dispatch")
-        try:
-            out = self._run(xx)
-        except BaseException:
-            self._count_outcome("failed")
-            raise
-        if deadline is not None \
-                and time.monotonic() >= deadline + _WAIT_SHED_GRACE:
-            self._count_outcome("shed", stage="wait", reason="expired")
-            raise DeadlineExceeded(
-                "deadline expired during the unfused forward",
-                stage="wait")
-        self._count_outcome("completed")
-        return out
+                # `put` — minus the lint-rejected blocking form. The item
+                # carries the admission span's context plus the enqueue
+                # timestamp: the collector turns them into the
+                # serve/queued span when it picks the request up.
+                self._q.put_nowait(
+                    (xx, fut, deadline, ctx, time.perf_counter()))
+        return fut, ctx
 
     # -- overload accounting --------------------------------------------------
 
@@ -587,16 +647,25 @@ class ParallelInference:
         with self._lock:
             self._queued_examples -= item[0].shape[0]
 
+    def _trace_shed(self, stage: str, reason: str,
+                    ctx: Optional[_tracing.SpanContext] = None):
+        _trace_shed_span(stage, reason, ctx)
+
     def _shed_if_expired(self, item, stage: str) -> bool:
         """Shed a queued request whose deadline passed while it waited —
         serving it would burn device time on a result nobody reads."""
-        _, fut, deadline = item
+        fut, deadline = item[1], item[2]
         if deadline is None or time.monotonic() < deadline:
             return False
-        self._fail(
-            fut,
-            DeadlineExceeded(f"deadline expired in {stage}", stage=stage),
-            outcome="shed", stage=stage, reason="expired")
+        if self._fail(
+                fut,
+                DeadlineExceeded(f"deadline expired in {stage}",
+                                 stage=stage),
+                outcome="shed", stage=stage, reason="expired"):
+            # span only when OUR fail won (and counted): a waiter that
+            # already shed this future recorded ITS span — the trace must
+            # mirror serving_shed_total, one shed, one stage
+            self._trace_shed(stage, "expired", item[3])
         return True
 
     def warmup(self, feature_shape: Optional[Sequence[int]] = None,
@@ -887,6 +956,7 @@ class ParallelInference:
             # thread owes progress (a block inside _emit's handoff put
             # means the device is wedged — exactly what should degrade)
             with hb.busy():
+                self._trace_queued(item)
                 group = [item]
                 count = item[0].shape[0]
                 # drain more requests until batch limit or short timeout
@@ -913,9 +983,19 @@ class ParallelInference:
                         # next group
                         pending = nxt
                         break
+                    self._trace_queued(nxt)
                     group.append(nxt)
                     count += nxt[0].shape[0]
                 self._emit(group)
+
+    def _trace_queued(self, item):
+        """Retroactive serve/queued span for a request entering a fused
+        group: enqueue time to now, parented to its admission span via
+        the context carried on the queue item — the explicit-context
+        handoff that keeps parentage across the collector thread."""
+        if item[3] is not None and _tracing.is_enabled():
+            _tracing.record_complete("serve/queued", item[4],
+                                     time.perf_counter(), item[3])
 
     def _emit(self, group):
         """Host-side batch assembly; blocks on the bounded handoff queue
@@ -925,14 +1005,17 @@ class ParallelInference:
                      if len(group) > 1 else group[0][0])
             padded, n, b = self._pad(batch)
         except BaseException as e:  # propagate to all waiting callers
-            for _, fut, _ in group:
-                self._fail(fut, e)
+            for g in group:
+                self._fail(g[1], e)
             return
         t0 = time.perf_counter()
-        futs = [fut for _, fut, _ in group]
+        futs = [g[1] for g in group]
+        # span contexts ride the handoff next to the futures: the second
+        # explicit-context hop, so dispatch/forward spans completed on
+        # the dispatcher thread still parent to each request's admission
         self._put_handoff(
             (padded, n, b, futs, [g[0].shape[0] for g in group],
-             [g[2] for g in group]), futs)
+             [g[2] for g in group], [g[3] for g in group]), futs)
         self._m_handoff.observe(time.perf_counter() - t0)
 
     # BATCHED pipeline, stage 2: device forward + scatter results
@@ -953,7 +1036,7 @@ class ParallelInference:
                 return
             if work is None:
                 return
-            padded, n, b, futs, sizes, deadlines = work
+            padded, n, b, futs, sizes, deadlines, ctxs = work
             # shed expired members BEFORE burning device time on them;
             # when the WHOLE group expired while the device was behind,
             # skip the forward entirely (that skip is what keeps an
@@ -964,23 +1047,41 @@ class ParallelInference:
             now = time.monotonic()
             live = [fut for fut, d in zip(futs, deadlines)
                     if d is None or now < d]
-            for fut, d in zip(futs, deadlines):
+            for fut, d, c in zip(futs, deadlines, ctxs):
                 if d is not None and now >= d:
-                    self._fail(
-                        fut,
-                        DeadlineExceeded("deadline expired before the "
-                                         "device forward",
-                                         stage="dispatch"),
-                        outcome="shed", stage="dispatch", reason="expired")
+                    if self._fail(
+                            fut,
+                            DeadlineExceeded("deadline expired before the "
+                                             "device forward",
+                                             stage="dispatch"),
+                            outcome="shed", stage="dispatch",
+                            reason="expired"):
+                        # span mirrors the counter: only when our fail
+                        # won the race against the waiter's own shed
+                        self._trace_shed("dispatch", "expired", c)
             if not live:
                 continue
+            live_ctxs = [c for c, d in zip(ctxs, deadlines)
+                         if (d is None or now < d) and c is not None]
             # busy only while a group is in hand: a forward that never
             # returns (device wedge) leaves this slot stale and the
             # watchdog flips serving_dispatcher to degraded/unhealthy
             with self._hb_dispatch.busy():
                 self._inflight = live
+                # the dispatch span runs ATTACHED to the first live
+                # request's admission context — the fused group's real
+                # spans (dispatch + nested serve/forward) join that
+                # request's trace; the other members get retroactive
+                # copies below so every trace in the group is complete
+                t_disp = time.perf_counter()
                 try:
-                    out = self._forward_padded(padded, n, b)
+                    with _tracing.attached_ctx(
+                            live_ctxs[0] if live_ctxs else None):
+                        with _tracing.span("serve/dispatch",
+                                           bucket=b, rows=n):
+                            t_fwd0 = time.perf_counter()
+                            out = self._forward_padded(padded, n, b)
+                            t_fwd1 = time.perf_counter()
                     off = 0
                     for fut, k in zip(futs, sizes):
                         # abort() may fail the future concurrently;
@@ -988,11 +1089,31 @@ class ParallelInference:
                         if not fut.done():
                             self._resolve(fut, self._rows(out, off, off + k))
                         off += k
+                    self._trace_group_copies(live_ctxs[1:], t_disp,
+                                             t_fwd0, t_fwd1, b, n)
                 except BaseException as e:  # propagate to waiting callers
                     for fut in futs:
                         self._fail(fut, e)
                 finally:
                     self._inflight = []
+
+    def _trace_group_copies(self, ctxs, t_disp, t_fwd0, t_fwd1, b, n):
+        """Retroactive dispatch+forward spans for the fused group's
+        NON-primary members: the device forward ran once, but each
+        member's trace must still show when its work was dispatched and
+        executed — otherwise every trace but the first ends at its
+        queued span."""
+        if not ctxs or not _tracing.is_enabled():
+            return
+        t1 = time.perf_counter()
+        for ctx in ctxs:
+            dctx = _tracing.record_complete(
+                "serve/dispatch", t_disp, t1, ctx, bucket=b, rows=n,
+                fused_copy=True)
+            if dctx is not None:
+                _tracing.record_complete(
+                    "serve/forward", t_fwd0, t_fwd1, dctx, bucket=b,
+                    rows=n, fused_copy=True)
 
 
 class ReplicaPool:
@@ -1162,6 +1283,7 @@ class ReplicaPool:
             key = f"resubmit/{reason}"
             self._pool_shed_by[key] = self._pool_shed_by.get(key, 0) + 1
         self._m_shed.labels("resubmit", reason).inc()
+        _trace_shed_span("resubmit", reason)  # caller-thread shed
 
     def output(self, x, deadline_ms: Optional[float] = None):
         """Thread-safe inference with failover: retryable replica
@@ -1210,6 +1332,10 @@ class ReplicaPool:
                             f"resubmits)", reason="retry_budget",
                             stage="resubmit") from last
                     self._m_rerouted.inc()
+                    # the retry runs on the caller's thread, so the next
+                    # replica's admission span joins this trace by stack;
+                    # the marker makes the failover hop itself visible
+                    _tracing.instant("serve/resubmit", resubmit=resubmits)
             now = time.monotonic()
             if req_deadline is not None and now >= req_deadline:
                 self._pool_shed("expired")

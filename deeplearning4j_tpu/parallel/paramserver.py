@@ -50,11 +50,16 @@ import numpy as np
 from deeplearning4j_tpu.utils import faultpoints as _faults
 from deeplearning4j_tpu.utils import health as _health
 from deeplearning4j_tpu.utils import metrics as _metrics
+from deeplearning4j_tpu.utils import tracing as _tracing
 from deeplearning4j_tpu.utils.concurrency import (
     QueueAborted,
     put_abortable,
 )
-from deeplearning4j_tpu.utils.jsonhttp import JsonHttpServer, json_response
+from deeplearning4j_tpu.utils.jsonhttp import (
+    JsonHttpServer,
+    json_response,
+    traced_headers,
+)
 
 logger = logging.getLogger("deeplearning4j_tpu")
 
@@ -314,7 +319,12 @@ class EmbeddingParameterServer:
             route = path.lstrip("/")
             t0 = time.perf_counter()
             try:
-                return self._post_timed(path, body)
+                # nests under jsonhttp's http/server span, which already
+                # joined the client's traceparent — a pull made mid-
+                # request shows up inside the caller's trace with the
+                # route named
+                with _tracing.span("ps/server/" + route):
+                    return self._post_timed(path, body)
             finally:
                 self._m_rpc.labels(route).inc()
                 self._m_rpc_sec.labels(route).observe(
@@ -423,23 +433,31 @@ class EmbeddingPSClient:
         return row % len(self.urls)
 
     def _post_bin(self, url: str, route: str, payload: bytes) -> bytes:
-        req = urllib.request.Request(
-            f"{url}{route}", data=payload,
-            headers={"Content-Type": "application/octet-stream"})
         label = route.lstrip("/")
         t0 = time.perf_counter()
-        try:  # count failures too (server side does the same): an outage
-            # must show up in the RPC series, not just the drop counter
-            # chaos hook: an `error` fault is a dropped/refused RPC (the
-            # retry/replay machinery absorbs it); `latency` is a slow
-            # network; `hang` is the wedged-endpoint case the push
-            # drain's heartbeat exists for
-            _faults.fault_point("paramserver_rpc", route=label)
-            with urllib.request.urlopen(req, timeout=self.timeout) as r:
-                return r.read()
-        finally:
-            self._m_rpc.labels(label).inc()
-            self._m_rpc_sec.labels(label).observe(time.perf_counter() - t0)
+        # the client RPC span opens FIRST so the traceparent injected
+        # below carries ITS context: the remote server's http/server span
+        # parents to this span, and the cross-process tree reads
+        # caller -> ps/client/<route> -> http/server -> ps/server/<route>
+        with _tracing.span("ps/client/" + label):
+            req = urllib.request.Request(
+                f"{url}{route}", data=payload,
+                headers=traced_headers(
+                    {"Content-Type": "application/octet-stream"}))
+            try:  # count failures too (server side does the same): an
+                # outage must show up in the RPC series, not just the
+                # drop counter
+                # chaos hook: an `error` fault is a dropped/refused RPC
+                # (the retry/replay machinery absorbs it); `latency` is a
+                # slow network; `hang` is the wedged-endpoint case the
+                # push drain's heartbeat exists for
+                _faults.fault_point("paramserver_rpc", route=label)
+                with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                    return r.read()
+            finally:
+                self._m_rpc.labels(label).inc()
+                self._m_rpc_sec.labels(label).observe(
+                    time.perf_counter() - t0)
 
     def _post_with_retry(self, url: str, route: str, payload: bytes,
                          deadline: Optional[float] = None) -> bytes:
@@ -520,8 +538,13 @@ class EmbeddingPSClient:
         if deltas.ndim != 2 or deltas.shape[0] != np.asarray(rows).size:
             raise ValueError(  # fail at the call site, not in the drain
                 f"deltas must be [n_rows, dim], got {deltas.shape}")
+        # the enqueue-time span context rides the item: the drain thread
+        # attaches it, so the push RPC's spans (and its traceparent to
+        # the server) stay in the trace of the step that produced the
+        # deltas instead of rooting fresh per-push traces
         item = (table, np.asarray(rows, np.int64),
-                np.asarray(deltas, np.float32))
+                np.asarray(deltas, np.float32),
+                _tracing.current_context())
         if self._stop.is_set() or not self._worker.is_alive():
             # the drain is gone: an enqueue would never be serviced —
             # count the drop instead of losing gradient mass silently
@@ -573,7 +596,8 @@ class EmbeddingPSClient:
         logger.warning("PS push dropped (%d total): %s",
                        self.dropped_pushes, why)
 
-    def _deliver(self, table: str, rows: np.ndarray, deltas: np.ndarray):
+    def _deliver(self, table: str, rows: np.ndarray, deltas: np.ndarray,
+                 ctx=None):
         """Route one push batch: per owning shard, the payload joins that
         endpoint's FIFO (behind anything parked from an outage — arrival
         order per shard is preserved) and the FIFO is flushed head-first."""
@@ -581,10 +605,12 @@ class EmbeddingPSClient:
             sel = np.nonzero(rows % len(self.urls) == s)[0]
             if sel.size == 0:
                 continue
-            # [payload, failed_before]: the flag turns a later delivery
-            # into a counted replay
+            # [payload, failed_before, ctx]: the flag turns a later
+            # delivery into a counted replay; the span context stays with
+            # ITS payload, so a parked push replayed while a newer item
+            # drains still reports under the trace that produced it
             self._pending[s].append(
-                [_pack_request(table, rows[sel], deltas[sel]), False])
+                [_pack_request(table, rows[sel], deltas[sel]), False, ctx])
             self._flush_endpoint(s)
 
     def _flush_endpoint(self, s: int):
@@ -592,7 +618,8 @@ class EmbeddingPSClient:
         while pend:
             rec = pend[0]
             try:
-                self._post_with_retry(self.urls[s], "/push.bin", rec[0])
+                with _tracing.attached_ctx(rec[2]):
+                    self._post_with_retry(self.urls[s], "/push.bin", rec[0])
             except Exception as e:
                 rec[1] = True
                 if self.replay_capacity == 0:
@@ -631,9 +658,10 @@ class EmbeddingPSClient:
                     with self._hb.busy():
                         self._flush_pending()
                 continue
+            table, rows, deltas, ctx = item
             try:
                 with self._hb.busy():
-                    self._deliver(*item)
+                    self._deliver(table, rows, deltas, ctx)
             finally:
                 self._q.task_done()
 

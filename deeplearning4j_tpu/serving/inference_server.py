@@ -22,7 +22,12 @@ device execution). Routes:
     GET  /metrics  -> {"requests", "examples", "batches", "queue_depth",
                        "buckets", "bucket_hits", "oversized",
                        "forward_compiles", "latency_ms":
-                       {"count", "mean_ms", "p50_ms", "p99_ms"}, ...}
+                       {"count", "mean_ms", "p50_ms", "p99_ms",
+                        "exemplars": [{"le_ms", "value_ms",
+                                       "trace_id"}, ...]},
+                       ...}
+                   (exemplars link latency-bucket maxima to trace ids
+                    when tracing is on — resolve one with `cli trace`)
     GET  /metrics?format=prometheus
                    -> text exposition of the process-global registry
                       (utils/metrics.py): serving series plus any
@@ -128,6 +133,18 @@ class InferenceServer:
         # JSON object keys must be strings; bucket sizes are ints
         m["bucket_hits"] = {str(k): v for k, v in m["bucket_hits"].items()}
         m["latency_ms"] = self.latency.snapshot()
+        # per-bucket latency exemplars (value + trace_id) from the cached
+        # serving_request_seconds child: the scrape-to-trace link —
+        # resolve one with `cli trace http://host:port --trace-id <id>`.
+        # Converted to ms-suffixed keys: everything else in this
+        # latency_ms object is milliseconds, and a seconds-valued field
+        # next to p99_ms is a silent 1000x misread
+        m["latency_ms"]["exemplars"] = [
+            {"le_ms": (e["le"] if isinstance(e["le"], str)
+                       else round(e["le"] * 1e3, 6)),
+             "value_ms": round(e["value"] * 1e3, 6),
+             "trace_id": e["trace_id"], "ts": e["ts"]}
+            for e in self._m_latency.exemplars()]
         return m
 
     # -- request handling ----------------------------------------------------
@@ -216,7 +233,12 @@ class InferenceServer:
                               f"got {deadline_ms!r}"}, 400)
         t0 = time.perf_counter()
         try:
-            with _tracing.span("serve/predict", examples=int(feats.shape[0])):
+            # the request's serving span: nests under jsonhttp's
+            # http/server span (which joined the caller's traceparent,
+            # or rooted a fresh trace) on this handler thread
+            sp = _tracing.span("serve/predict",
+                               examples=int(feats.shape[0]))
+            with sp:
                 out = self.inference.output(feats, deadline_ms=deadline_ms)
         except RequestValidationError as e:  # the client's fault
             return json_response({"error": str(e)}, 400)
@@ -241,14 +263,22 @@ class InferenceServer:
             return json_response({"error": f"{type(e).__name__}: {e}"}, 500)
         dt = time.perf_counter() - t0
         self.latency.record(dt)
-        self._m_latency.observe(dt)
-        if isinstance(out, list):  # multi-output graph: one entry per head
-            preds = [np.asarray(o)[0].tolist() if single
-                     else np.asarray(o).tolist() for o in out]
-        else:
-            out = np.asarray(out)
-            preds = (out[0] if single else out).tolist()
-        return json_response({"predictions": preds})
+        # exemplar link: the histogram keeps (value, trace_id) on new
+        # bucket maxima, so a p99 outlier in the scrape resolves via
+        # `cli trace` to the exact trace that produced it. sp.context is
+        # None when tracing is off (NULL_SPAN) — a plain observation.
+        ctx = sp.context
+        self._m_latency.observe(
+            dt, trace_id=ctx.trace_id if ctx is not None else None)
+        with _tracing.span("serve/respond"):
+            if isinstance(out, list):  # multi-output graph: one entry
+                # per head
+                preds = [np.asarray(o)[0].tolist() if single
+                         else np.asarray(o).tolist() for o in out]
+            else:
+                out = np.asarray(out)
+                preds = (out[0] if single else out).tolist()
+            return json_response({"predictions": preds})
 
     # -- lifecycle -----------------------------------------------------------
 

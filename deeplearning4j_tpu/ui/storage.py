@@ -25,7 +25,9 @@ from typing import Callable, Dict, List, Optional
 
 from deeplearning4j_tpu.ui.codec import decode_record, encode_record
 from deeplearning4j_tpu.utils import health as _health
+from deeplearning4j_tpu.utils import tracing as _tracing
 from deeplearning4j_tpu.utils.concurrency import QueueAborted, get_abortable
+from deeplearning4j_tpu.utils.jsonhttp import traced_headers
 
 
 class StatsStorageRouter:
@@ -313,17 +315,23 @@ class RemoteUIStatsStorageRouter(StatsStorageRouter):
     def _drain(self):
         while True:
             try:
-                route, session_id, body, ctype = get_abortable(
+                route, session_id, body, ctype, ctx = get_abortable(
                     self._q, self._stop)
             except QueueAborted:
                 return
-            req = urllib.request.Request(
-                f"{self.url}{route}", data=body,
-                headers={"Content-Type": ctype,
-                         "X-Session-Id": session_id})
             try:
-                with self._hb.busy():
-                    urllib.request.urlopen(req, timeout=self.timeout).read()
+                # attach the enqueue-time span context so the POST (and
+                # its traceparent header) joins the training step's trace
+                # across this queue hop instead of rooting a fresh one
+                with self._hb.busy(), _tracing.attached_ctx(ctx):
+                    req = urllib.request.Request(
+                        f"{self.url}{route}", data=body,
+                        headers=traced_headers(
+                            {"Content-Type": ctype,
+                             "X-Session-Id": session_id}))
+                    with _tracing.span("ui/remote_post", route=route):
+                        urllib.request.urlopen(
+                            req, timeout=self.timeout).read()
             except OSError:
                 pass  # dashboard unreachable — drop the record
             finally:
@@ -354,8 +362,10 @@ class RemoteUIStatsStorageRouter(StatsStorageRouter):
 
     def put_static_info(self, session_id, info):
         self._enqueue(("/remote/static", session_id,
-                       json.dumps(info).encode(), "application/json"))
+                       json.dumps(info).encode(), "application/json",
+                       _tracing.current_context()))
 
     def put_update(self, session_id, record):
         self._enqueue(("/remote/update", session_id,
-                       encode_record(record), "application/octet-stream"))
+                       encode_record(record), "application/octet-stream",
+                       _tracing.current_context()))

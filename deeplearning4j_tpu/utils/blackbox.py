@@ -144,6 +144,20 @@ class FlightRecorder:
             return
         ev = {"ts": round(time.time(), 3), "kind": kind}
         ev.update(fields)
+        # cross-reference into the distributed-tracing layer: an event
+        # recorded while a span is active carries its trace id, so a
+        # crash dump names the trace of the request that was in flight
+        # (one flag check when tracing is off; never fatal — the black
+        # box must record even if tracing misbehaves)
+        if "trace_id" not in ev:
+            try:
+                from deeplearning4j_tpu.utils import tracing as _tracing
+
+                tid = _tracing.current_trace_id()
+                if tid is not None:
+                    ev["trace_id"] = tid
+            except Exception:
+                pass
         with self._lock:
             self._events.append(ev)
 
@@ -385,14 +399,20 @@ def render_dump(doc: dict, max_steps: int = 32,
         lines.append("")
         lines.append(f"events (newest last, {len(events)}):")
         for ev in events[-max_steps:]:
+            # the trace id renders as its own column: it is the grep key
+            # into span exports / logs, not just another payload field
+            tid = ev.get("trace_id")
+            trace_note = f"  [trace {tid}]" if tid else ""
             if ev.get("kind") == "oom":
                 lines.append(f"  {ev.get('ts')}  oom  "
-                             f"where={ev.get('where')} "
+                             f"where={ev.get('where')}{trace_note} "
                              "(see OOM forensics below)")
                 continue
-            extra = {k: v for k, v in ev.items() if k not in ("ts", "kind")}
+            extra = {k: v for k, v in ev.items()
+                     if k not in ("ts", "kind", "trace_id")}
             lines.append(f"  {ev.get('ts')}  {ev.get('kind')}"
-                         + (f"  {extra}" if extra else ""))
+                         + (f"  {extra}" if extra else "")
+                         + trace_note)
     oom = next((ev for ev in reversed(events)
                 if ev.get("kind") == "oom"), None)
     if oom is not None:

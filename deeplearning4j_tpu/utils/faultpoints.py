@@ -347,9 +347,9 @@ def fault_point(point: str, **ctx) -> None:
 
 
 def _observe(point: str, kind: str, invocation: int, ctx: dict):
-    """Injected faults are observable like real ones: a registry series
-    and a flight-recorder event (never fatal — a metrics bug must not
-    change the injected behavior)."""
+    """Injected faults are observable like real ones: a registry series,
+    a flight-recorder event, and a span-tree marker in the active trace
+    (never fatal — a metrics bug must not change the injected behavior)."""
     try:
         from deeplearning4j_tpu.utils import metrics as _metrics
 
@@ -364,5 +364,16 @@ def _observe(point: str, kind: str, invocation: int, ctx: dict):
         _blackbox.get_recorder().record_event(
             "fault_injected", point=point, kind=kind,
             invocation=invocation, **{k: str(v) for k, v in ctx.items()})
+    except Exception:
+        pass
+    try:
+        # with tracing on, the fault lands INSIDE the trace of the
+        # request/step it hit (fault points sit inside lifecycle spans,
+        # or under an attach()ed context on pipeline threads) — `cli
+        # chaos --trace-out` asserts exactly this linkage
+        from deeplearning4j_tpu.utils import tracing as _tracing
+
+        _tracing.instant("fault/injected", point=point, kind=kind,
+                         invocation=invocation)
     except Exception:
         pass

@@ -10,6 +10,17 @@ trickle or stall the body — pins one `dl4j-http-*` thread forever and,
 repeated, starves the ThreadingHTTPServer. Timed-out connections are
 dropped (no response: the peer is by definition not reading) and
 counted under `http_request_timeout_total`.
+
+Distributed tracing (utils/tracing, W3C trace-context): with tracing
+enabled, every dispatched request runs under an `http/server` span that
+JOINS the caller's trace when the request carries a valid `traceparent`
+header (inference server, paramserver routes, the UI remote receiver —
+every server on this scaffold inherits it), and roots a fresh trace when
+it doesn't — a malformed header is treated as absent, never as a
+half-empty context. On the client side, `traced_headers()` merges the
+active context into an outbound header dict. Both hooks degrade to one
+flag check when tracing is off (the serving hot-path overhead guard
+covers them).
 """
 
 from __future__ import annotations
@@ -23,6 +34,7 @@ from typing import Callable, Optional, Tuple
 
 from deeplearning4j_tpu.utils import faultpoints as _faults
 from deeplearning4j_tpu.utils import metrics as _metrics
+from deeplearning4j_tpu.utils import tracing as _tracing
 
 # handler contract: fn(path, body_bytes, headers) ->
 #   (status, content_type, payload_bytes)            or
@@ -62,6 +74,18 @@ def json_response(obj, code: int = 200,
 
 def html_response(text: str, code: int = 200) -> Tuple[int, str, bytes]:
     return code, "text/html", text.encode()
+
+
+def traced_headers(headers: Optional[dict] = None) -> dict:
+    """Outbound header dict with the active span context injected as a
+    W3C `traceparent` — the client half of cross-process propagation
+    (paramserver client, UI remote router). One flag check when tracing
+    is off; the input dict is never mutated."""
+    out = dict(headers) if headers else {}
+    tp = _tracing.current_traceparent()
+    if tp is not None:
+        out["traceparent"] = tp
+    return out
 
 
 class JsonHttpServer:
@@ -119,31 +143,53 @@ class JsonHttpServer:
                     outer._m_timeouts.inc()
                     self.close_connection = True
                     return
+                # trace join: a valid traceparent header makes this
+                # request's spans part of the caller's trace; absent or
+                # malformed -> attach(None), a clean fresh root. The
+                # whole block is one flag check when tracing is off.
+                traced = _tracing.is_enabled()
+                if traced:
+                    tok = _tracing.attach(_tracing.parse_traceparent(
+                        self.headers.get("traceparent")))
+                    span = _tracing.span("http/server",
+                                         method=self.command,
+                                         path=self.path)
+                else:
+                    span = _tracing.NULL_SPAN
                 try:
-                    # chaos hook: an `error` fault here is a handler
-                    # crash (500, connection survives); a `latency`/
-                    # `hang` is a stalled handler thread
-                    _faults.fault_point("http_handler", path=self.path)
-                    out = handler(self.path, body, dict(self.headers)) \
-                        if handler else None
-                    if out is None:
-                        out = json_response({"error": "not found"}, 404)
-                except _faults.FaultInjected as e:
-                    out = json_response(
-                        {"error": f"{type(e).__name__}: {e}"}, 500)
-                except Exception as e:  # keep serving
-                    out = json_response(
-                        {"error": f"{type(e).__name__}: {e}"}, 400)
-                code, ctype, payload = out[:3]
-                extra = out[3] if len(out) > 3 else None
-                self.send_response(code)
-                self.send_header("Content-Type", ctype)
-                self.send_header("Content-Length", str(len(payload)))
-                if extra:
-                    for k, v in extra.items():
-                        self.send_header(k, str(v))
-                self.end_headers()
-                self.wfile.write(payload)
+                    with span:
+                        try:
+                            # chaos hook: an `error` fault here is a
+                            # handler crash (500, connection survives); a
+                            # `latency`/`hang` is a stalled handler thread
+                            _faults.fault_point("http_handler",
+                                                path=self.path)
+                            out = handler(self.path, body,
+                                          dict(self.headers)) \
+                                if handler else None
+                            if out is None:
+                                out = json_response(
+                                    {"error": "not found"}, 404)
+                        except _faults.FaultInjected as e:
+                            out = json_response(
+                                {"error": f"{type(e).__name__}: {e}"}, 500)
+                        except Exception as e:  # keep serving
+                            out = json_response(
+                                {"error": f"{type(e).__name__}: {e}"}, 400)
+                        code, ctype, payload = out[:3]
+                        extra = out[3] if len(out) > 3 else None
+                        self.send_response(code)
+                        self.send_header("Content-Type", ctype)
+                        self.send_header("Content-Length",
+                                         str(len(payload)))
+                        if extra:
+                            for k, v in extra.items():
+                                self.send_header(k, str(v))
+                        self.end_headers()
+                        self.wfile.write(payload)
+                finally:
+                    if traced:
+                        _tracing.detach(tok)
 
             def do_GET(self):
                 self._dispatch(outer._get)

@@ -33,6 +33,21 @@ Histograms use fixed log-scale buckets (seconds-oriented by default:
 observations for p50/p99 readout, reusing utils/latency.py's
 nearest-rank percentile — the same numbers an operator already gets
 from LatencyTracker, now for every timed phase in the framework.
+
+Exemplars (the Prometheus/OpenMetrics idea, JSON-surfaced): when a
+histogram observation lands while a trace is active (utils/tracing), and
+it is a new maximum for its bucket — or the bucket's stored exemplar has
+gone stale (older than _EXEMPLAR_MAX_AGE) — the (value, trace_id) pair
+is kept, bounded at one exemplar per bucket, so a p99 outlier in a
+latency histogram links back to a concrete trace an operator can pull
+apart with `cli trace`. The staleness refresh matters: the span ring the
+trace_id resolves against is bounded, so an all-time bucket maximum
+would eventually advertise a trace no export can produce — a recent
+slightly-smaller observation beats a permanently unresolvable record.
+Exposed through `snapshot()` (and therefore the inference server's
+`GET /metrics`); the 0.0.4 text exposition stays exemplar-free
+(exemplars are OpenMetrics syntax — emitting them there would break
+strict 0.0.4 parsers).
 """
 
 from __future__ import annotations
@@ -40,9 +55,11 @@ from __future__ import annotations
 import bisect
 import math
 import threading
+import time
 from collections import deque
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
+from deeplearning4j_tpu.utils import tracing as _tracing
 from deeplearning4j_tpu.utils.latency import percentile
 
 # default log-scale bucket bounds (seconds): 1e-4 .. 1e2 at 1/2.5/5 per
@@ -50,6 +67,12 @@ from deeplearning4j_tpu.utils.latency import percentile
 DEFAULT_BUCKETS = tuple(
     m * 10.0 ** e for e in range(-4, 3) for m in (1.0, 2.5, 5.0)
 )
+
+# a bucket exemplar older than this is replaced by the NEXT traced
+# observation in that bucket even when smaller: its trace has likely
+# aged out of the bounded span ring, and a resolvable recent trace
+# beats an unresolvable all-time maximum
+_EXEMPLAR_MAX_AGE = 60.0
 
 
 def _check_labels(values: Sequence[str], names: Tuple[str, ...]):
@@ -144,7 +167,8 @@ class GaugeChild(_Child):
 
 
 class HistogramChild(_Child):
-    __slots__ = ("_bounds", "_counts", "_count", "_sum", "_window")
+    __slots__ = ("_bounds", "_counts", "_count", "_sum", "_window",
+                 "_exemplars")
 
     def __init__(self, bounds: Tuple[float, ...], window: int = 2048):
         super().__init__()
@@ -153,15 +177,30 @@ class HistogramChild(_Child):
         self._count = 0
         self._sum = 0.0
         self._window = deque(maxlen=window)
+        # bucket index -> (value, trace_id, ts): the bucket's max-value
+        # exemplar — bounded at len(bounds)+1 entries by construction
+        self._exemplars: Dict[int, Tuple[float, str, float]] = {}
 
-    def observe(self, value: float):
+    def observe(self, value: float, trace_id: Optional[str] = None):
+        """Record one observation. `trace_id` links it to a trace for
+        exemplar capture; when omitted, the active trace (utils/tracing)
+        is used — one flag check when tracing is off, so the hot paths
+        that observe with tracing disabled pay nothing."""
         v = float(value)
         i = bisect.bisect_left(self._bounds, v)
+        if trace_id is None and _tracing.is_enabled():
+            trace_id = _tracing.current_trace_id()
         with self._lock:
             self._counts[i] += 1
             self._count += 1
             self._sum += v
             self._window.append(v)
+            if trace_id is not None:
+                now = round(time.time(), 3)
+                ex = self._exemplars.get(i)
+                if ex is None or v > ex[0] \
+                        or now - ex[2] > _EXEMPLAR_MAX_AGE:
+                    self._exemplars[i] = (v, trace_id, now)
 
     @property
     def count(self) -> int:
@@ -189,6 +228,20 @@ class HistogramChild(_Child):
             acc += c
             out.append((bound, acc))
         out.append((float("inf"), acc + counts[-1]))
+        return out
+
+    def exemplars(self) -> List[dict]:
+        """Per-bucket max-value exemplars, smallest bucket first — each
+        links a concrete observation to the trace that produced it.
+        JSON-safe: the +Inf bound renders as the string "+Inf"."""
+        with self._lock:
+            items = sorted(self._exemplars.items())
+        bounds = self._bounds
+        out = []
+        for i, (v, trace_id, ts) in items:
+            le = bounds[i] if i < len(bounds) else float("inf")
+            out.append({"le": "+Inf" if math.isinf(le) else le,
+                        "value": v, "trace_id": trace_id, "ts": ts})
         return out
 
 
@@ -244,8 +297,8 @@ class MetricFamily:
     def set_function(self, fn: Callable[[], float]):
         self.labels().set_function(fn)
 
-    def observe(self, value: float):
-        self.labels().observe(value)
+    def observe(self, value: float, trace_id: Optional[str] = None):
+        self.labels().observe(value, trace_id)
 
     @property
     def value(self):
@@ -357,6 +410,7 @@ class MetricsRegistry:
                             ["+Inf" if math.isinf(le) else le, c]
                             for le, c in child.cumulative_buckets()
                         ],
+                        "exemplars": child.exemplars(),
                     })
                 else:
                     v = child.value

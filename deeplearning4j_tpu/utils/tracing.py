@@ -1,11 +1,23 @@
-"""Host-side span tracing — the Dapper-style request/step half of the
-observability layer (counters live in utils/metrics.py).
+"""Host-side distributed tracing — the Dapper-style request/step half of
+the observability layer (counters live in utils/metrics.py).
 
-A span is a named, timed section of host code with a thread-local parent
-stack, so `span("fit/step")` containing `span("fit/device_sync")` nests
-the way Dapper trees do. Completed spans land in a bounded ring buffer
-(old traffic ages out; a serving process never grows without bound) and
-export two ways:
+A span is a named, timed section of host code. Every span belongs to a
+**trace**: the root span of a causal chain mints a 128-bit `trace_id`
+(W3C trace-context format), and children inherit it — through the
+thread-local parent stack on one thread, through an explicit
+`SpanContext` handed across a queue to another thread (`attach()` /
+`detach()` / `attached_ctx`), or through a W3C `traceparent` header
+across a process boundary (`format_traceparent` / `parse_traceparent`;
+utils/jsonhttp joins incoming headers on the server side and
+`traced_headers()` injects them on the client side). A shed 429 or a
+p99 outlier is therefore attributable: grep one `trace_id` across span
+exports, JSON logs (`configure_logging(json_lines=True)`), flight-
+recorder events, and histogram exemplars (utils/metrics.py), then feed
+the export to `cli trace` (analysis/tracecrit.py) for the span tree and
+its critical path.
+
+Completed spans land in a bounded ring buffer (old traffic ages out; a
+serving process never grows without bound) and export two ways:
 
 * JSONL — one span per line, newest last (`InferenceServer GET /trace`,
   `TracingListener(jsonl_path=...)`); greppable, tail-able.
@@ -18,24 +30,103 @@ Device correlation: when enabled, each span also enters
 `jax.profiler.trace()` capture — `cli profile` op tables and host spans
 line up by name.
 
-Overhead contract: tracing is OFF by default and `span()` on the
-disabled path returns a shared no-op context manager after one flag
-check — no allocation, no lock, no clock read. The fit loop's phase
-timers depend on this (ISSUE acceptance: ≤2% step-time regression with
-tracing disabled).
+Overhead contract: tracing is OFF by default and every propagation entry
+point — `span()`, `instant()`, `attach()`/`detach()`,
+`current_context()`, `current_traceparent()`, `record_complete()` —
+degrades to one flag check on the disabled path: no allocation, no lock,
+no clock read, no id minting. The fit loop's phase timers and the
+serving/jsonhttp hot paths depend on this (the <10µs-per-call guard in
+tests covers span creation AND the context hooks).
 """
 
 from __future__ import annotations
 
 import json
 import itertools
+import os
 import threading
 import time
 from collections import deque
 from typing import List, Optional
 
-_counter = itertools.count(1)
+# span ids are ints, unique within a process and unlikely to collide
+# across processes: the counter starts at a random 60-bit offset so two
+# processes exporting into one trace don't both hand out 1, 2, 3...
+# (traceparent masks to the W3C 64-bit field; parse restores the int)
+_counter = itertools.count(
+    (int.from_bytes(os.urandom(5), "big") << 20) + 1)
 _tls = threading.local()
+
+_SPAN_ID_MASK = (1 << 64) - 1
+
+# attach() on the disabled path returns this token; detach() recognizes
+# it and does nothing — the pair stays one flag check when tracing is off
+_DISABLED_TOKEN = object()
+
+
+def _mint_trace_id() -> str:
+    """128-bit random trace id, 32 lowercase hex chars (W3C format)."""
+    return os.urandom(16).hex()
+
+
+class SpanContext:
+    """The thread/process-portable identity of a span: which trace it
+    belongs to and which span is the parent of anything recorded under
+    it. Hand one across a queue (`attach()`) or a process boundary
+    (`traceparent()`) and parentage survives the hop."""
+
+    __slots__ = ("trace_id", "span_id")
+
+    def __init__(self, trace_id: str, span_id: int):
+        self.trace_id = trace_id
+        self.span_id = int(span_id)
+
+    def traceparent(self) -> str:
+        """W3C trace-context header value: 00-<trace>-<span>-01."""
+        return (f"00-{self.trace_id}"
+                f"-{self.span_id & _SPAN_ID_MASK:016x}-01")
+
+    def __repr__(self):  # debugging / assertion messages
+        return f"SpanContext({self.trace_id!r}, {self.span_id})"
+
+
+def format_traceparent(ctx: SpanContext) -> str:
+    return ctx.traceparent()
+
+
+_HEX_DIGITS = frozenset("0123456789abcdefABCDEF")
+
+
+def _is_hex(s: str) -> bool:
+    # NOT int(s, 16): that tolerates '+'/'-' signs and '_' separators, so
+    # a malformed header would join the trace and be re-emitted outbound
+    # as a W3C-invalid traceparent strict downstream tracers drop
+    return not set(s) - _HEX_DIGITS
+
+
+def parse_traceparent(value: Optional[str]) -> Optional[SpanContext]:
+    """Parse a W3C traceparent header into a SpanContext, or None when
+    the header is absent or malformed — a bad header must yield a fresh
+    root downstream, never a half-empty context."""
+    if not value:
+        return None
+    parts = value.strip().split("-")
+    if len(parts) < 4:
+        return None
+    ver, tid, sid = parts[0], parts[1], parts[2]
+    if len(ver) != 2 or len(tid) != 32 or len(sid) != 16:
+        return None
+    if not (_is_hex(ver) and _is_hex(tid) and _is_hex(sid)):
+        return None
+    if ver.lower() == "ff":
+        return None
+    if ver == "00" and len(parts) != 4:
+        # version 00 is exactly 4 fields; FUTURE versions may append more
+        return None
+    span_id = int(sid, 16)
+    if span_id == 0 or set(tid) == {"0"}:
+        return None
+    return SpanContext(tid.lower(), span_id)
 
 
 class _NullSpan:
@@ -49,12 +140,17 @@ class _NullSpan:
     def __exit__(self, *exc):
         return False
 
+    @property
+    def context(self):
+        return None
+
 
 NULL_SPAN = _NullSpan()
 
 
 class _Span:
-    __slots__ = ("tracer", "name", "args", "id", "parent", "t0", "_ann")
+    __slots__ = ("tracer", "name", "args", "id", "parent", "trace",
+                 "t0", "_ann")
 
     def __init__(self, tracer: "Tracer", name: str, args: Optional[dict]):
         self.tracer = tracer
@@ -62,14 +158,34 @@ class _Span:
         self.args = args
         self.id = next(_counter)
         self.parent = None
+        self.trace = None
         self.t0 = 0.0
         self._ann = None
+
+    @property
+    def context(self) -> SpanContext:
+        """This span's identity — valid during AND after the span (the
+        exemplar/latency record after a `with` block still needs it)."""
+        return SpanContext(self.trace, self.id)
 
     def __enter__(self):
         stack = getattr(_tls, "stack", None)
         if stack is None:
             stack = _tls.stack = []
-        self.parent = stack[-1].id if stack else None
+        if stack:
+            top = stack[-1]
+            self.parent = top.id
+            self.trace = top.trace
+        else:
+            # thread-root span: an attach()ed context (the explicit
+            # cross-thread / cross-process handoff) parents it; with
+            # nothing attached this span is a trace root and mints the id
+            att = getattr(_tls, "attached", None)
+            if att is not None:
+                self.parent = att.span_id
+                self.trace = att.trace_id
+            else:
+                self.trace = _mint_trace_id()
         stack.append(self)
         if self.tracer.annotate_device:
             ann = _trace_annotation(self.name)
@@ -87,7 +203,7 @@ class _Span:
         if stack and stack[-1] is self:
             stack.pop()
         self.tracer._record(self.name, self.t0, t1 - self.t0, self.id,
-                            self.parent, self.args)
+                            self.parent, self.args, trace=self.trace)
         return False
 
 
@@ -127,15 +243,44 @@ class Tracer:
 
     def instant(self, name: str, **args):
         """Zero-duration marker event (compile-cache insertions, helper
-        auto-disables, ...)."""
+        auto-disables, injected faults, ...). Parents to the innermost
+        active span — or the attach()ed context on a worker thread — so
+        markers land inside the trace that caused them."""
         if not self.enabled:
             return
         stack = getattr(_tls, "stack", None)
-        parent = stack[-1].id if stack else None
+        if stack:
+            parent, trace = stack[-1].id, stack[-1].trace
+        else:
+            att = getattr(_tls, "attached", None)
+            if att is not None:
+                parent, trace = att.span_id, att.trace_id
+            else:
+                parent, trace = None, _mint_trace_id()
         self._record(name, time.perf_counter(), 0.0, next(_counter),
-                     parent, args or None, phase="i")
+                     parent, args or None, phase="i", trace=trace)
 
-    def _record(self, name, t0, dur, span_id, parent, args, phase="X"):
+    def record_complete(self, name: str, t0: float, t1: float,
+                        parent: Optional[SpanContext] = None,
+                        **args) -> Optional[SpanContext]:
+        """Record an already-finished span from explicit timestamps
+        (time.perf_counter() domain) under an explicit parent context —
+        the retroactive form the serving pipeline uses for per-request
+        lifecycle spans measured across thread handoffs (a queued-time
+        span is only known when the collector picks the request up).
+        Returns the recorded span's context (chain children off it), or
+        None when tracing is disabled."""
+        if not self.enabled:
+            return None
+        sid = next(_counter)
+        trace = parent.trace_id if parent is not None else _mint_trace_id()
+        self._record(name, t0, t1 - t0, sid,
+                     parent.span_id if parent is not None else None,
+                     args or None, trace=trace)
+        return SpanContext(trace, sid)
+
+    def _record(self, name, t0, dur, span_id, parent, args, phase="X",
+                trace=None):
         ev = {
             "name": name,
             "ph": phase,
@@ -143,6 +288,7 @@ class Tracer:
             "dur": round(dur * 1e6, 3),
             "id": span_id,
             "parent": parent,
+            "trace": trace,
             "tid": threading.get_ident(),
         }
         if args:
@@ -189,6 +335,8 @@ class Tracer:
             args["span_id"] = ev["id"]
             if ev.get("parent") is not None:
                 args["parent_span_id"] = ev["parent"]
+            if ev.get("trace"):
+                args["trace_id"] = ev["trace"]
             ce["args"] = args
             events.append(ce)
         return {"traceEvents": events, "displayTimeUnit": "ms"}
@@ -231,3 +379,82 @@ def span(name: str, **args):
 
 def instant(name: str, **args):
     _TRACER.instant(name, **args)
+
+
+def record_complete(name: str, t0: float, t1: float,
+                    parent: Optional[SpanContext] = None,
+                    **args) -> Optional[SpanContext]:
+    return _TRACER.record_complete(name, t0, t1, parent, **args)
+
+
+# -- context propagation ------------------------------------------------------
+
+def current_context() -> Optional[SpanContext]:
+    """The active span context on this thread: the innermost open span,
+    else the attach()ed handoff context, else None. Disabled -> None
+    after one flag check."""
+    if not _TRACER.enabled:
+        return None
+    stack = getattr(_tls, "stack", None)
+    if stack:
+        top = stack[-1]
+        return SpanContext(top.trace, top.id)
+    return getattr(_tls, "attached", None)
+
+
+def current_trace_id() -> Optional[str]:
+    """Just the active trace id (log records, flight-recorder events)."""
+    if not _TRACER.enabled:
+        return None
+    stack = getattr(_tls, "stack", None)
+    if stack:
+        return stack[-1].trace
+    att = getattr(_tls, "attached", None)
+    return att.trace_id if att is not None else None
+
+
+def current_traceparent() -> Optional[str]:
+    """The active context as a W3C traceparent header value, or None —
+    what an outbound HTTP client attaches so the remote server joins
+    this trace."""
+    ctx = current_context()
+    return ctx.traceparent() if ctx is not None else None
+
+
+def attach(ctx: Optional[SpanContext]):
+    """Make `ctx` the ambient parent for root spans (and instants) on
+    THIS thread — the explicit handoff that keeps parentage across a
+    queue hop (collector -> dispatcher, prefetch workers, push drains)
+    instead of silently starting new roots. Returns a token for
+    detach(); always pair them (or use `attached_ctx`). attach(None)
+    deliberately clears the ambient context (a worker starting an item
+    that carried no context must not inherit the previous item's)."""
+    if not _TRACER.enabled:
+        return _DISABLED_TOKEN
+    prev = getattr(_tls, "attached", None)
+    _tls.attached = ctx
+    return prev
+
+
+def detach(token):
+    """Restore the ambient context saved by the paired attach()."""
+    if token is _DISABLED_TOKEN:
+        return
+    _tls.attached = token
+
+
+class attached_ctx:
+    """`with tracing.attached_ctx(ctx): ...` — scope-bound attach/detach."""
+
+    __slots__ = ("ctx", "_tok")
+
+    def __init__(self, ctx: Optional[SpanContext]):
+        self.ctx = ctx
+
+    def __enter__(self):
+        self._tok = attach(self.ctx)
+        return self.ctx
+
+    def __exit__(self, *exc):
+        detach(self._tok)
+        return False

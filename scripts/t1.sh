@@ -87,6 +87,12 @@ fi
 if [ -n "${T1_METRICS_DUMP:-}" ]; then
     echo "T1 metrics snapshot: ${T1_METRICS_ARTIFACT:-/tmp/_t1_metrics.json}"
 fi
+# T1_TRACE_DUMP=1 makes tests/conftest.py export the session's span ring
+# as JSONL (T1_TRACE_ARTIFACT, default /tmp/_t1_trace.jsonl) — render
+# with `python -m deeplearning4j_tpu.cli trace <artifact>`.
+if [ -n "${T1_TRACE_DUMP:-}" ]; then
+    echo "T1 trace dump: ${T1_TRACE_ARTIFACT:-/tmp/_t1_trace.jsonl}"
+fi
 # surface the conftest thread-leak guard's session verdict (each leak also
 # failed its test above — this is the at-a-glance summary)
 grep -a '^T1 THREAD GUARD:' /tmp/_t1.log || echo "T1 THREAD GUARD: no verdict line (session died early?)"

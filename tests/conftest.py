@@ -60,6 +60,40 @@ from deeplearning4j_tpu.utils import devprof as _devprof  # noqa: E402
 
 _devprof.configure(sample_every=0)
 
+# Opt-in trace artifact (scripts/t1.sh T1_TRACE_DUMP=1): accumulate every
+# span any tracing-enabled test records into one session JSONL, next to
+# the metrics dump. Tests deliberately clear the global ring in their
+# teardown (never leak spans across tests), so a plain end-of-session
+# export would be empty — instead the global tracer's clear() flushes the
+# ring to the artifact first, and sessionfinish flushes the remainder.
+_t1_trace_path = (os.environ.get("T1_TRACE_ARTIFACT", "/tmp/_t1_trace.jsonl")
+                  if os.environ.get("T1_TRACE_DUMP") else None)
+if _t1_trace_path:
+    import json as _json
+
+    from deeplearning4j_tpu.utils import tracing as _t1_tracing
+
+    try:
+        os.unlink(_t1_trace_path)  # fresh artifact per session
+    except OSError:
+        pass
+
+    def _t1_trace_flush():
+        evs = _t1_tracing.get_tracer().recent()
+        if evs:
+            with open(_t1_trace_path, "a") as f:
+                for ev in evs:
+                    f.write(_json.dumps(ev) + "\n")
+
+    _t1_orig_clear = _t1_tracing.Tracer.clear
+
+    def _t1_clear_with_flush(self):
+        if self is _t1_tracing.get_tracer():
+            _t1_trace_flush()
+        _t1_orig_clear(self)
+
+    _t1_tracing.Tracer.clear = _t1_clear_with_flush
+
 
 def pytest_configure(config):
     config.addinivalue_line(
@@ -175,6 +209,17 @@ def pytest_sessionfinish(session, exitstatus):
               f"activation_peak_bytes={_cm.activation_peak_bytes}")
     except Exception as e:  # the snapshot must never fail the suite
         print(f"T1 PERF SNAPSHOT: unavailable ({type(e).__name__}: {e})")
+
+    # Opt-in trace artifact (scripts/t1.sh T1_TRACE_DUMP=1): flush
+    # whatever the session's final tests left in the ring; everything
+    # earlier was flushed by the clear() hook above. Render with
+    # `cli trace <artifact>`.
+    if _t1_trace_path:
+        try:
+            _t1_trace_flush()
+        except Exception as e:  # an artifact failure must not fail the
+            # suite
+            print(f"[conftest] trace dump failed: {e}", file=sys.stderr)
 
     # Opt-in observability artifact (scripts/t1.sh T1_METRICS_DUMP=1):
     # dump the process-global metrics registry after the run so compile

@@ -1,0 +1,649 @@
+"""Distributed-tracing tests: trace identity (roots mint, children
+inherit), W3C traceparent round-trips, explicit-context thread handoff
+(attach/detach), the serving request lifecycle across the collector/
+dispatcher threads, cross-process propagation through a subprocess
+paramserver, histogram exemplars resolving to traces via the
+critical-path analyzer, trace ids in JSON logs and flight-recorder
+events, and the <10µs disabled-path guard extended to the context-
+propagation hooks."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import deeplearning4j_tpu as dl4j
+from deeplearning4j_tpu.analysis import tracecrit
+from deeplearning4j_tpu.nn.conf import (
+    DenseLayer,
+    NeuralNetConfiguration,
+    OutputLayer,
+    Updater,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.utils import metrics as metrics_mod
+from deeplearning4j_tpu.utils import tracing
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _tracing_off_after():
+    """Tracing is process-global state; never leak an enabled tracer (or
+    a dirty span buffer) into other tests."""
+    yield
+    tracing.enable(False)
+    tracing.get_tracer().clear()
+
+
+def _mlp_conf(seed=7, n_in=12):
+    return (
+        NeuralNetConfiguration.builder()
+        .seed(seed)
+        .updater(Updater.SGD)
+        .learning_rate(0.05)
+        .weight_init("xavier")
+        .list()
+        .layer(DenseLayer(n_in=n_in, n_out=16, activation="tanh"))
+        .layer(OutputLayer(n_in=16, n_out=4, activation="softmax",
+                           loss="mcxent"))
+        .build()
+    )
+
+
+def _spans():
+    return tracing.get_tracer().recent()
+
+
+def _chain_names(evs, leaf):
+    """Span names from `leaf` up to its root via parent links."""
+    by_id = {e["id"]: e for e in evs}
+    names, cur = [], leaf
+    while cur is not None:
+        names.append(cur["name"])
+        cur = by_id.get(cur.get("parent"))
+    return names
+
+
+# -- trace identity + context objects -----------------------------------------
+
+def test_root_mints_trace_children_inherit():
+    tracing.get_tracer().clear()
+    tracing.enable(True)
+    with tracing.span("outer") as outer:
+        with tracing.span("inner"):
+            tracing.instant("marker")
+    with tracing.span("other_root"):
+        pass
+    evs = _spans()
+    by_name = {e["name"]: e for e in evs}
+    t = by_name["outer"]["trace"]
+    assert t and len(t) == 32 and int(t, 16)  # 128-bit hex
+    assert by_name["inner"]["trace"] == t
+    assert by_name["marker"]["trace"] == t
+    # a sibling root is a DIFFERENT trace
+    assert by_name["other_root"]["trace"] != t
+    # the span's context survives the with-block (exemplar linkage)
+    assert outer.context.trace_id == t
+
+
+def test_traceparent_roundtrip_and_malformed():
+    ctx = tracing.SpanContext("ab" * 16, 12345)
+    tp = tracing.format_traceparent(ctx)
+    assert tp == f"00-{'ab' * 16}-0000000000003039-01"
+    back = tracing.parse_traceparent(tp)
+    assert back.trace_id == ctx.trace_id and back.span_id == 12345
+    for bad in (None, "", "garbage", "00-short-0000000000003039-01",
+                "00-" + "0" * 32 + "-0000000000003039-01",  # zero trace
+                "00-" + "ab" * 16 + "-0000000000000000-01",  # zero span
+                "ff-" + "ab" * 16 + "-0000000000003039-01",  # bad version
+                "00-" + "zz" * 16 + "-0000000000003039-01",  # non-hex
+                # int(x, 16) traps: signs / underscores are NOT hex
+                "00-" + "a" * 30 + "_1-0000000000003039-01",
+                "+0-" + "ab" * 16 + "-0000000000003039-01",
+                "00-" + "ab" * 16 + "-+000000000003039-01",
+                # version 00 is exactly 4 fields
+                "00-" + "ab" * 16 + "-0000000000003039-01-extra"):
+        assert tracing.parse_traceparent(bad) is None, bad
+    # a FUTURE version may carry extra fields — still parses
+    fut = tracing.parse_traceparent(
+        "01-" + "ab" * 16 + "-0000000000003039-01-extra")
+    assert fut is not None and fut.span_id == 12345
+
+
+def test_attach_keeps_parentage_across_threads():
+    tracing.get_tracer().clear()
+    tracing.enable(True)
+    with tracing.span("producer") as sp:
+        ctx = sp.context
+
+        def worker():
+            tok = tracing.attach(ctx)
+            try:
+                with tracing.span("consumer"):
+                    pass
+                tracing.instant("consumer_marker")
+            finally:
+                tracing.detach(tok)
+            # after detach the thread roots fresh traces again
+            with tracing.span("detached_root"):
+                pass
+
+        t = threading.Thread(target=worker, daemon=True,
+                             name="dl4j-test-trace-worker")
+        t.start()
+        t.join(10)
+    evs = _spans()
+    by_name = {e["name"]: e for e in evs}
+    assert by_name["consumer"]["parent"] == ctx.span_id
+    assert by_name["consumer"]["trace"] == ctx.trace_id
+    assert by_name["consumer_marker"]["trace"] == ctx.trace_id
+    assert by_name["detached_root"]["trace"] != ctx.trace_id
+    assert by_name["detached_root"]["parent"] is None
+
+
+def test_disabled_path_overhead_under_10us():
+    """The overhead contract extended to context propagation: with
+    tracing OFF, span creation AND every propagation hook are a flag
+    check — pinned well under 10µs/call (the devprof on_step bound)."""
+    assert not tracing.is_enabled()
+    n = 20_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        tracing.span("hot/span")
+    per_span = (time.perf_counter() - t0) / n
+    t0 = time.perf_counter()
+    for _ in range(n):
+        tracing.current_context()
+        tracing.current_traceparent()
+    per_ctx = (time.perf_counter() - t0) / n
+    t0 = time.perf_counter()
+    for _ in range(n):
+        tracing.detach(tracing.attach(None))
+        tracing.record_complete("x", 0.0, 0.0)
+    per_hop = (time.perf_counter() - t0) / n
+    assert per_span < 10e-6, f"span() cost {per_span * 1e6:.2f}us"
+    assert per_ctx < 10e-6, f"context reads cost {per_ctx * 1e6:.2f}us"
+    assert per_hop < 10e-6, f"attach/record cost {per_hop * 1e6:.2f}us"
+
+
+# -- serving lifecycle across pipeline threads --------------------------------
+
+def test_fused_group_dispatch_parents_to_admission():
+    """The cross-thread orphaning fix, pinned: a fused group's dispatch
+    span (completed on the dispatcher thread) parents to each member
+    request's admission span through the explicit-context handoff at
+    both queues — no more thread-local fresh roots."""
+    from deeplearning4j_tpu.parallel.inference import ParallelInference
+
+    net = MultiLayerNetwork(_mlp_conf()).init()
+    pi = ParallelInference(net, max_batch_size=2, buckets=[2],
+                           batch_timeout_ms=500.0,
+                           component_prefix="trace_fuse")
+    try:
+        pi.warmup((12,))
+        tracing.get_tracer().clear()
+        tracing.enable(True)
+        errs = []
+
+        def call(i):
+            try:
+                with tracing.span(f"client{i}"):
+                    pi.output(np.zeros((1, 12), np.float32))
+            except Exception as e:  # pragma: no cover - failure report
+                errs.append(e)
+
+        threads = [threading.Thread(target=call, args=(i,), daemon=True,
+                                    name=f"dl4j-test-fuse-{i}")
+                   for i in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60)
+        assert not errs
+        assert pi.metrics()["batches"] == 1, "requests did not fuse"
+    finally:
+        tracing.enable(False)
+        pi.shutdown()
+    evs = _spans()
+    admissions = [e for e in evs if e["name"] == "serve/admission"]
+    dispatches = [e for e in evs if e["name"] == "serve/dispatch"]
+    forwards = [e for e in evs if e["name"] == "serve/forward"]
+    queued = [e for e in evs if e["name"] == "serve/queued"]
+    assert len(admissions) == 2
+    assert len(dispatches) == 2  # one real + one fused copy
+    assert len(forwards) == 2
+    assert len(queued) == 2
+    adm_ids = {e["id"] for e in admissions}
+    # EVERY member's dispatch span parents to an admission span, and the
+    # two dispatches cover both members' traces
+    assert {d["parent"] for d in dispatches} == adm_ids
+    assert ({d["trace"] for d in dispatches}
+            == {a["trace"] for a in admissions})
+    assert {q["parent"] for q in queued} == adm_ids
+    disp_ids = {d["id"] for d in dispatches}
+    assert {f["parent"] for f in forwards} == disp_ids
+    # each client's trace is complete: client -> admission -> dispatch
+    for d in dispatches:
+        chain = _chain_names(evs, d)
+        assert chain[1] == "serve/admission", chain
+        assert chain[-1].startswith("client"), chain
+
+
+def _http_json(port, path, payload=None, headers=None):
+    import urllib.request
+
+    data = None if payload is None else json.dumps(payload).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=data,
+        headers={"Content-Type": "application/json", **(headers or {})})
+    with urllib.request.urlopen(req, timeout=60) as r:
+        return r.read().decode()
+
+
+def test_rest_request_yields_one_trace_with_full_lifecycle():
+    """Acceptance: one /predict with tracing on -> a single trace whose
+    span tree carries HTTP server, admission, queued, dispatch and
+    device-forward spans in parent order across three threads; and a
+    caller-provided traceparent makes that trace the CALLER's."""
+    from deeplearning4j_tpu.serving import InferenceServer
+
+    net = MultiLayerNetwork(_mlp_conf()).init()
+    server = InferenceServer(net, port=0, warmup_shape=(12,))
+    port = server.start()
+    tracing.get_tracer().clear()
+    tracing.enable(True)
+    caller = tracing.SpanContext(os.urandom(16).hex(), 77)
+    try:
+        _http_json(port, "/predict",
+                   {"features": np.zeros((2, 12)).tolist()},
+                   headers={"traceparent": caller.traceparent()})
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and not any(
+                e["name"] == "serve/forward"
+                for e in _spans()):
+            time.sleep(0.05)
+    finally:
+        tracing.enable(False)
+        server.stop()
+    evs = _spans()
+    fw = [e for e in evs if e["name"] == "serve/forward"]
+    assert fw, "no device-forward span recorded"
+    chain = _chain_names(evs, fw[0])
+    assert chain == ["serve/forward", "serve/dispatch", "serve/admission",
+                     "serve/predict", "http/server"]
+    lifecycle = [e for e in evs
+                 if e["name"].startswith(("serve/", "http/"))]
+    traces = {e["trace"] for e in lifecycle}
+    assert traces == {caller.trace_id}, \
+        "request spans split across traces (or ignored the traceparent)"
+    # the queued span is in the same trace, parented at admission
+    queued = [e for e in evs if e["name"] == "serve/queued"]
+    adm = next(e for e in evs if e["name"] == "serve/admission")
+    assert queued and queued[0]["parent"] == adm["id"]
+    # the http/server root joined the CALLER's span id
+    http = next(e for e in evs if e["name"] == "http/server")
+    assert http["parent"] == caller.span_id
+
+
+def test_no_header_request_gets_fresh_root():
+    """A request without (or with a malformed) traceparent must root a
+    complete fresh trace — never a half-empty context."""
+    from deeplearning4j_tpu.utils.jsonhttp import (
+        JsonHttpServer,
+        json_response,
+    )
+
+    server = JsonHttpServer(get=lambda p, b, h: json_response({"ok": 1}))
+    port = server.start()
+    tracing.get_tracer().clear()
+    tracing.enable(True)
+    try:
+        _http_json(port, "/x")
+        _http_json(port, "/y", headers={"traceparent": "garbage-header"})
+    finally:
+        tracing.enable(False)
+        server.stop()
+    https = [e for e in _spans() if e["name"] == "http/server"]
+    assert len(https) == 2
+    for e in https:
+        assert e["parent"] is None
+        assert e["trace"] and len(e["trace"]) == 32
+    assert https[0]["trace"] != https[1]["trace"]
+
+
+# -- exemplars -> cli trace (the scrape-to-trace link) ------------------------
+
+def test_exemplar_resolves_to_trace_critical_path():
+    """Acceptance: a latency-histogram exemplar from GET /metrics names a
+    trace_id; pulling GET /trace and running the critical-path analyzer
+    on that id yields a complete trace whose critical-path sum is within
+    tolerance of the recorded request latency."""
+    from deeplearning4j_tpu.serving import InferenceServer
+
+    # fresh latency family: earlier traced tests in this process may have
+    # pinned bucket exemplars whose (still-young) traces were cleared
+    # from the span ring — this test asserts the fresh-request link
+    metrics_mod.get_registry().unregister("serving_request_seconds")
+    net = MultiLayerNetwork(_mlp_conf(seed=23)).init()
+    server = InferenceServer(net, port=0, warmup_shape=(12,))
+    port = server.start()
+    tracing.get_tracer().clear()
+    tracing.enable(True)
+    try:
+        _http_json(port, "/predict",
+                   {"features": np.zeros((3, 12)).tolist()})
+        metrics = json.loads(_http_json(port, "/metrics"))
+        exemplars = metrics["latency_ms"]["exemplars"]
+        assert exemplars, "no latency exemplar after a traced request"
+        trace_text = _http_json(port, "/trace")
+    finally:
+        tracing.enable(False)
+        server.stop()
+    events = tracecrit.parse_jsonl(trace_text)
+    exported = {e.get("trace") for e in events}
+    ex = next(e for e in exemplars if e["trace_id"] in exported)
+    report = tracecrit.analyze(events, trace_id=ex["trace_id"])
+    assert len(report["traces"]) == 1
+    tr = report["traces"][0]
+    names = {s["name"] for s in tr["critical_path"]}
+    assert "http/server" in names and "serve/forward" in names
+    crit_s = tr["critical_path_us"] / 1e6
+    latency_s = ex["value_ms"] / 1e3  # latency_ms fields are all ms
+    # the critical path covers the http/server root, which brackets the
+    # measured /predict latency; tolerance absorbs handler overhead and
+    # a loaded 2-core CI box
+    assert abs(crit_s - latency_s) <= max(0.15, 0.5 * latency_s), \
+        (crit_s, latency_s)
+
+
+def test_exemplars_bounded_one_per_bucket_and_trace_gated():
+    reg = metrics_mod.MetricsRegistry()
+    h = reg.histogram("x_seconds", buckets=(0.01, 0.1, 1.0)).labels()
+    # no trace, no tracing -> no exemplars, ever
+    for v in (0.005, 0.05, 0.5, 5.0):
+        h.observe(v)
+    assert h.exemplars() == []
+    # explicit trace ids: bounded at one (max-value) exemplar per bucket
+    for i in range(50):
+        h.observe(0.001 * (i + 1), trace_id=f"t{i}")
+        h.observe(0.02 * (i + 1), trace_id=f"u{i}")
+    h.observe(5.0, trace_id="overflow")
+    ex = h.exemplars()
+    assert len(ex) <= 4  # 3 bounds + the +Inf bucket
+    by_le = {e["le"]: e for e in ex}
+    assert by_le[0.01]["trace_id"] == "t9"  # 0.010 is the bucket max
+    assert by_le[1.0]["trace_id"] == "u49"  # 0.02*50 = 1.0, le semantics
+    assert by_le["+Inf"]["trace_id"] == "overflow"
+    # snapshot carries them, strict-JSON safe
+    snap = reg.snapshot()
+    json.dumps(snap, allow_nan=False)
+    assert snap["x_seconds"]["values"][0]["exemplars"] == ex
+
+
+# -- cross-process propagation (paramserver) ----------------------------------
+
+def test_paramserver_pull_joins_trace_across_process(tmp_path):
+    """Acceptance satellite: the client's traceparent shows up as the
+    subprocess server's route-span parentage in its exported JSONL."""
+    from deeplearning4j_tpu.parallel.paramserver import EmbeddingPSClient
+
+    child_out = str(tmp_path / "child_spans.jsonl")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("T1_BLACKBOX_ARTIFACT", None)
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "tests", "tracing_ps_child.py"),
+         child_out],
+        env=env, cwd=REPO, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True)
+    client = None
+    try:
+        line = proc.stdout.readline()
+        assert line.startswith("PORT "), \
+            f"child failed to start: {line!r} / {proc.stderr.read()[:2000]}"
+        port = int(line.split()[1])
+        tracing.get_tracer().clear()
+        tracing.enable(True)
+        client = EmbeddingPSClient([f"http://127.0.0.1:{port}"])
+        with tracing.span("test/pull") as sp:
+            got = client.pull("syn0", np.array([1, 3]))
+            parent_trace = sp.context.trace_id
+        assert got.shape == (2, 4)
+        tracing.enable(False)
+        proc.stdin.write("done\n")
+        proc.stdin.flush()
+        assert "DUMPED" in (proc.stdout.readline() or "")
+        proc.wait(timeout=30)
+    finally:
+        if client is not None:
+            client.close()
+        if proc.poll() is None:
+            proc.kill()
+    # client side: test/pull -> ps/client/pull.bin in one trace
+    local = _spans()
+    ps_client = next(e for e in local if e["name"] == "ps/client/pull.bin")
+    assert ps_client["trace"] == parent_trace
+    # server side (OTHER PROCESS): http/server joined the client's trace,
+    # parented to the client RPC span; the route span nests inside
+    with open(child_out) as f:
+        remote = tracecrit.parse_jsonl(f.read())
+    http = [e for e in remote if e["name"] == "http/server"]
+    assert http and http[0]["trace"] == parent_trace
+    assert http[0]["parent"] == ps_client["id"]
+    route = [e for e in remote if e["name"] == "ps/server/pull.bin"]
+    assert route and route[0]["trace"] == parent_trace
+    assert route[0]["parent"] == http[0]["id"]
+
+
+def test_parked_push_replays_under_its_own_trace():
+    """A push parked during an endpoint outage must deliver under the
+    trace that PRODUCED it, not under whatever newer item happened to be
+    draining when the endpoint recovered — the per-record context on the
+    replay buffer."""
+    import socket
+
+    from deeplearning4j_tpu.parallel.paramserver import (
+        EmbeddingParameterServer,
+        EmbeddingPSClient,
+    )
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    tracing.get_tracer().clear()
+    tracing.enable(True)
+    client = EmbeddingPSClient([f"http://127.0.0.1:{port}"],
+                               timeout=2.0, max_retries=0,
+                               retry_backoff=0.01)
+    server = None
+    try:
+        with tracing.span("producer_a") as spa:
+            client.push_async("syn0", np.array([1]),
+                              np.ones((1, 4), np.float32))
+        # let the drain attempt + park it against the dead endpoint
+        deadline = time.monotonic() + 10
+        while client.pending_pushes() == 0 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert client.pending_pushes() == 1, "push A never parked"
+        server = EmbeddingParameterServer(
+            {"syn0": np.zeros((8, 4), np.float32)}, port=port)
+        server.start()
+        with tracing.span("producer_b") as spb:
+            client.push_async("syn0", np.array([2]),
+                              np.ones((1, 4), np.float32))
+        client.flush()
+        deadline = time.monotonic() + 10
+        while server.pushes_applied < 2 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert server.pushes_applied == 2
+    finally:
+        tracing.enable(False)
+        client.close()
+        if server is not None:
+            server.stop()
+    pushes = [e for e in _spans() if e["name"] == "ps/client/push.bin"]
+    traces = {e["trace"] for e in pushes}
+    # A's replay reported under A's trace, B's under B's — both present
+    assert spa.context.trace_id in traces, "parked push lost its trace"
+    assert spb.context.trace_id in traces
+
+
+# -- satellites: logs, blackbox, analyzer, cli --------------------------------
+
+def test_json_logs_carry_trace_and_span_ids():
+    import io
+    import logging
+
+    buf = io.StringIO()
+    lg = dl4j.configure_logging(level=logging.INFO, json_lines=True,
+                                stream=buf)
+    try:
+        tracing.enable(True)
+        with tracing.span("logged") as sp:
+            lg.info("inside span")
+            ctx = sp.context
+        tracing.enable(False)
+        lg.info("outside span")
+        recs = [json.loads(l) for l in buf.getvalue().strip().splitlines()]
+        assert recs[0]["trace_id"] == ctx.trace_id
+        assert recs[0]["span_id"] == format(ctx.span_id, "016x")
+        assert recs[1]["trace_id"] == "" and recs[1]["span_id"] == ""
+    finally:
+        for h in list(lg.handlers):
+            if getattr(h, "_dl4j_tpu_configured", False):
+                lg.removeHandler(h)
+
+
+def test_blackbox_event_carries_trace_id_and_renders(capsys):
+    from deeplearning4j_tpu.utils.blackbox import FlightRecorder, render_dump
+
+    rec = FlightRecorder()
+    tracing.enable(True)
+    with tracing.span("crashy_request") as sp:
+        rec.record_event("replica_evicted", replica=1, reason="test")
+        tid = sp.context.trace_id
+    tracing.enable(False)
+    rec.record_event("untraced_event")
+    snap = rec.snapshot(reason="test")
+    evs = {e["kind"]: e for e in snap["events"]}
+    assert evs["replica_evicted"]["trace_id"] == tid
+    assert "trace_id" not in evs["untraced_event"]
+    out = render_dump(snap)
+    assert f"[trace {tid}]" in out
+
+
+def test_tracecrit_critical_path_synthetic():
+    t = "ab" * 16
+    events = [
+        {"name": "root", "ph": "X", "ts": 0.0, "dur": 100.0, "id": 1,
+         "parent": None, "trace": t, "tid": 1},
+        {"name": "early", "ph": "X", "ts": 0.0, "dur": 40.0, "id": 2,
+         "parent": 1, "trace": t, "tid": 1},
+        {"name": "late", "ph": "X", "ts": 50.0, "dur": 45.0, "id": 3,
+         "parent": 1, "trace": t, "tid": 2},
+        {"name": "shadowed", "ph": "X", "ts": 52.0, "dur": 10.0, "id": 4,
+         "parent": 1, "trace": t, "tid": 3},  # inside `late`'s window
+        {"name": "leaf", "ph": "X", "ts": 60.0, "dur": 20.0, "id": 5,
+         "parent": 3, "trace": t, "tid": 2},
+    ]
+    report = tracecrit.analyze(events)
+    assert report["n_traces"] == 1
+    tr = report["traces"][0]
+    path = [s["name"] for s in tr["critical_path"]]
+    # the chain walks backward from root's end: late (ends 95) then
+    # early (ends 40 <= late's start 50); `shadowed` overlaps late and
+    # never gates the end — it must not appear
+    assert path == ["root", "early", "late", "leaf"]
+    assert "shadowed" not in path
+    by_name = {s["name"]: s for s in tr["critical_path"]}
+    assert by_name["root"]["self_us"] == pytest.approx(15.0, abs=0.1)
+    assert by_name["late"]["self_us"] == pytest.approx(25.0, abs=0.1)
+    assert tr["critical_path_us"] == pytest.approx(100.0, abs=0.5)
+
+
+def test_cli_trace_renders_file_export(tmp_path, capsys):
+    from deeplearning4j_tpu.cli import main
+
+    tracing.get_tracer().clear()
+    tracing.enable(True)
+    with tracing.span("outer"):
+        with tracing.span("inner"):
+            pass
+    tracing.enable(False)
+    path = str(tmp_path / "spans.jsonl")
+    tracing.get_tracer().write_jsonl(path)
+    assert main(["trace", path]) == 0
+    out = capsys.readouterr().out
+    assert "critical path" in out and "outer" in out
+    # --trace-id prefix resolution + --json round-trip
+    tid = _spans()[0]["trace"]
+    assert main(["trace", path, "--trace-id", tid[:12], "--json", "-"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["traces"][0]["trace_id"] == tid
+    # a missing id is a nonzero exit (scriptable resolution check)
+    assert main(["trace", path, "--trace-id", "f" * 32]) == 1
+
+
+def test_cli_chaos_trace_out_links_faults_to_requests(tmp_path, capsys):
+    """The serving chaos preset under --trace-out: the run's span export
+    is written, and every injected fault's marker sits inside a request
+    trace that also carries the serve/* lifecycle spans."""
+    from deeplearning4j_tpu.cli import main
+
+    plan_path = str(tmp_path / "plan.json")
+    with open(plan_path, "w") as f:
+        json.dump({"seed": 5, "rules": [
+            {"point": "replica_forward", "kind": "latency",
+             "every_nth": 2, "latency_ms": 5.0}]}, f)
+    trace_path = str(tmp_path / "chaos_spans.jsonl")
+    rc = main(["chaos", "--preset", "serving", "--plan", plan_path,
+               "--requests", "12", "--clients", "2",
+               "--trace-out", trace_path, "--json", "-"])
+    report = json.loads(capsys.readouterr().out)
+    assert rc == 0, report
+    assert not tracing.is_enabled()  # restored after the run
+    tr = report["trace"]
+    assert tr["path"] == trace_path and os.path.exists(trace_path)
+    assert tr["fault_spans"] >= 1, "plan fired no faults"
+    assert tr["fault_trace_ok"] is True
+    assert tr["fault_spans_linked"] == tr["fault_spans"]
+    with open(trace_path) as f:
+        events = tracecrit.parse_jsonl(f.read())
+    assert any(e["name"] == "fault/injected" for e in events)
+
+
+def test_device_prefetch_stage_joins_iterating_trace():
+    """The prefetch thread handoff keeps parentage: staging spans from
+    the background device-prefetch worker land in the trace that is
+    consuming the iterator, not in fresh per-worker roots."""
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.data.iterators import ExistingDataSetIterator
+    from deeplearning4j_tpu.data.prefetch import DevicePrefetchIterator
+
+    rng = np.random.default_rng(0)
+    sets = [DataSet(rng.standard_normal((4, 3)).astype(np.float32),
+                    np.eye(2, dtype=np.float32)[rng.integers(0, 2, 4)])
+            for _ in range(3)]
+    base = ExistingDataSetIterator(sets)
+    tracing.get_tracer().clear()
+    tracing.enable(True)
+    it = DevicePrefetchIterator(base, depth=1,
+                                stage="trace_test_prefetch")
+    try:
+        with tracing.span("epoch") as sp:
+            n = sum(1 for _ in it)
+        assert n == 3
+    finally:
+        tracing.enable(False)
+        it.close()
+    stages = [e for e in _spans() if e["name"] == "prefetch/stage"]
+    assert len(stages) == 3
+    assert {e["trace"] for e in stages} == {sp.context.trace_id}
+    assert {e["parent"] for e in stages} == {sp.context.span_id}

@@ -1,0 +1,40 @@
+"""Subprocess half of the cross-process trace-propagation test
+(tests/test_tracing_distributed.py).
+
+Runs an EmbeddingParameterServer with tracing enabled, prints the bound
+port, then waits on stdin; any line (or EOF) makes it export its span
+ring as JSONL to the path in argv[1] and exit. The parent asserts that
+the trace id it minted client-side shows up in THIS process's export
+with the client RPC span as the server route span's ancestor — the W3C
+traceparent hop across a real process boundary.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    out_path = sys.argv[1]
+
+    import numpy as np
+
+    from deeplearning4j_tpu.parallel.paramserver import (
+        EmbeddingParameterServer,
+    )
+    from deeplearning4j_tpu.utils import tracing
+
+    tracing.enable(True)
+    server = EmbeddingParameterServer(
+        {"syn0": np.zeros((16, 4), np.float32)})
+    port = server.start()
+    print(f"PORT {port}", flush=True)
+    sys.stdin.readline()  # parent says "done" (or died: EOF)
+    server.stop()
+    tracing.get_tracer().write_jsonl(out_path)
+    print("DUMPED", flush=True)
+
+
+if __name__ == "__main__":
+    main()
