@@ -586,7 +586,8 @@ def bench_parallel_inference(max_batch=64, n_requests=512, clients=16,
 
 def bench_parallel_inference_overload(duration=3.0, n_in=64, hidden=64,
                                       classes=8, max_batch=4,
-                                      queue_capacity=None, slo_ms=100.0):
+                                      queue_capacity=None, slo_ms=100.0,
+                                      ledger_path=None):
     """Graceful degradation under sustained ~2x overload — the numbers
     the admission-control/load-shedding tier is graded on, recorded next
     to the throughput benches instead of only living in a slow test.
@@ -596,7 +597,14 @@ def bench_parallel_inference_overload(duration=3.0, n_in=64, hidden=64,
     MUST shed the excess. Reported: shed rate, p99 latency of ADMITTED
     requests vs the SLO (overload must turn into fast 429s, not
     universal lateness), max queue depth vs capacity (boundedness), and
-    the conservation law admitted == completed + shed + failed."""
+    the conservation law admitted == completed + shed + failed.
+
+    The run additionally records a persistent run ledger
+    (utils/runledger) with the default SLO rule pack derived from this
+    workload's serving config — the soak gate: the verdict embeds which
+    rules fired, `slo_ok` must stay True at the committed operating
+    point, and the artifact replays offline via `cli slo --ledger
+    <path> --check` / `cli metrics --ledger <path>`."""
     import threading
 
     from deeplearning4j_tpu.nn.conf import (
@@ -649,6 +657,27 @@ def bench_parallel_inference_overload(duration=3.0, n_in=64, hidden=64,
                            handoff_capacity=1, default_deadline_ms=slo_ms,
                            component_prefix="bench_overload")
     pi.warmup((n_in,))
+    # the soak ledger: continuous samples + the default rule pack for
+    # THIS serving config, judged live on the recorder thread. Attached
+    # AFTER warmup so the objective only grades traffic.
+    import tempfile
+
+    from deeplearning4j_tpu.analysis.slo import default_rule_pack
+    from deeplearning4j_tpu.utils import runledger as _runledger
+
+    if ledger_path is None:
+        ledger_path = os.path.join(
+            tempfile.gettempdir(),
+            f"BENCH_overload_ledger_{os.getpid()}.jsonl")
+    ledger = _runledger.RunLedger(
+        ledger_path, sample_every=max(0.25, duration / 8.0),
+        rules=default_rule_pack(
+            serving={"default_deadline_ms": slo_ms,
+                     "queue_capacity": queue_capacity,
+                     "handoff_capacity": 1,
+                     "component": "bench_overload"},
+            sample_every=max(0.25, duration / 8.0)))
+    _runledger.attach(ledger)
     rng = np.random.default_rng(0)
     reqs = [rng.standard_normal((1, n_in)).astype(np.float32)
             for _ in range(64)]
@@ -712,6 +741,12 @@ def bench_parallel_inference_overload(duration=3.0, n_in=64, hidden=64,
     stalled = [k for k, v in comps.items()
                if k.startswith("bench_overload")
                and v.get("status") != "ok"]
+    # close the ledger (final sample) BEFORE reading the verdict: the
+    # rule states are part of the committed operating point — an
+    # ERROR-severity firing here fails the soak gate
+    ledger.close()
+    slo_fired = ledger.rules.ever_fired()
+    slo_fired_errors = ledger.rules.ever_fired("error")
     pi.shutdown()
     if client_errors:
         raise RuntimeError(f"overload client died: {client_errors[:3]}")
@@ -743,6 +778,16 @@ def bench_parallel_inference_overload(duration=3.0, n_in=64, hidden=64,
         "clients": clients,
         "p50_ms": snap["p50_ms"],
         "seconds": round(base_dt + over_dt, 3),
+        # the continuous-judgment half: rule verdicts from the run
+        # ledger (replay: cli slo --ledger <path> --check)
+        "slo": {
+            "ledger": ledger_path,
+            "run_id": ledger.run_id,
+            "rules": [r.name for r in ledger.rules.rules],
+            "fired": slo_fired,
+            "fired_errors": slo_fired_errors,
+        },
+        "slo_ok": not slo_fired_errors,
     }
 
 

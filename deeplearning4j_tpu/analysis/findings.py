@@ -35,6 +35,11 @@ cost model (analysis/costmodel — static device cost of the train step):
   JX008  static residency estimate (params + updater + data +
          activation liveness peak) exceeds device HBM — will OOM
 
+SLO rules (analysis/slo evaluated on the run ledger, utils/runledger):
+  SLO001 a declarative SLO rule entered `firing` (severity = the
+         rule's own: a burning latency objective is an error, an
+         MFU-below-roofline drift a warning)
+
 concurrency lint (AST over the repo itself):
   CC001  bare `except:`
   CC002  queue put/get without timeout/abort in thread code

@@ -509,6 +509,8 @@ def cmd_metrics(args) -> int:
     import json as _json
     import urllib.request
 
+    if getattr(args, "ledger", None):
+        return _metrics_replay(args)
     if args.watch is not None:
         return _metrics_watch(args)
     if args.url:
@@ -560,6 +562,25 @@ def _scrape_scalars(url, timeout: float) -> dict:
     return out
 
 
+def _print_metrics_tick(prev: dict, now: dict, header: str):
+    """One watch/replay tick: counters and histogram counts as deltas,
+    gauges as changed current values — the ONE delta rendering shared by
+    the live watch loop and the ledger replay."""
+    print(header)
+    for key in sorted(now):
+        if ":bucket:" in key:  # ledger samples carry buckets; the
+            continue           # tick view stays the scalar one
+        v = now[key]
+        is_rate = key.endswith((":count", ":sum")) \
+            or key.split("{")[0].endswith("_total")
+        if is_rate:
+            dv = v - prev.get(key, 0.0)
+            if dv:
+                print(f"  {key}  +{dv:g}  (total {v:g})")
+        elif v != prev.get(key):
+            print(f"  {key}  {v:g}")
+
+
 def _metrics_watch(args) -> int:
     """Periodic re-scrape: counters and histogram counts print as deltas
     per tick, gauges as current values. Ctrl-C (or --watch-count) ends."""
@@ -574,20 +595,176 @@ def _metrics_watch(args) -> int:
             now = _scrape_scalars(args.url, args.timeout)
             ticks += 1
             stamp = _time.strftime("%H:%M:%S")
-            print(f"-- {stamp} (every {period:g}s, tick {ticks}) --")
-            for key in sorted(now):
-                v = now[key]
-                is_rate = key.endswith((":count", ":sum")) \
-                    or key.split("{")[0].endswith("_total")
-                if is_rate:
-                    dv = v - prev.get(key, 0.0)
-                    if dv:
-                        print(f"  {key}  +{dv:g}  (total {v:g})")
-                elif v != prev.get(key):
-                    print(f"  {key}  {v:g}")
+            _print_metrics_tick(
+                prev, now, f"-- {stamp} (every {period:g}s, tick {ticks}) --")
             prev = now
     except KeyboardInterrupt:
         pass
+    return 0
+
+
+def _metrics_replay(args) -> int:
+    """`cli metrics --ledger <path>`: replay a recorded run ledger
+    tick-by-tick with the live watch's delta rendering — post-mortems
+    read the same view the operator would have watched, without the
+    process being alive. `--watch-count` caps the ticks printed."""
+    import os
+    import time as _time
+
+    from deeplearning4j_tpu.utils import runledger
+
+    if not os.path.exists(args.ledger):
+        print(f"ledger not found: {args.ledger}", file=sys.stderr)
+        return 2
+    doc = runledger.read_ledger(args.ledger)
+    man = doc["manifest"]
+    print(f"replaying {args.ledger} — run {man.get('run_id')} "
+          f"(sampled every {man.get('sample_every')}s)")
+    alert_rows = list(runledger.iter_alerts(doc))
+    prev: dict = {}
+    ticks = 0
+    t_prev = None
+    for ts, now in runledger.iter_samples(doc):
+        ticks += 1
+        if args.watch_count > 0 and ticks > args.watch_count:
+            print(f"... ({args.watch_count} of the recorded ticks shown; "
+                  "raise --watch-count for more)")
+            break
+        stamp = _time.strftime("%H:%M:%S", _time.localtime(ts))
+        dt = f" (+{ts - t_prev:.1f}s)" if t_prev is not None else ""
+        _print_metrics_tick(prev, now, f"-- {stamp}{dt} tick {ticks} --")
+        for a in alert_rows:
+            if (t_prev or 0) < a["ts"] <= ts:
+                print(f"  !! SLO {a['rule']} -> {a['to']} "
+                      f"(value {a.get('value')})")
+        prev, t_prev = now, ts
+    return 0
+
+
+def cmd_slo(args) -> int:
+    """Offline SLO re-evaluation of a recorded run ledger
+    (utils/runledger + analysis/slo): replay the sample stream through
+    the rule-set — the one embedded in the ledger's manifest by
+    default, or `--rules <json>` to re-judge the same run under
+    different objectives — and report each rule's lifecycle. With
+    `--check`, exit 1 when any ERROR-severity rule fired at any point:
+    the CI/soak gate (`bench.py parallel_inference --overload` records
+    exactly such a ledger)."""
+    import json as _json
+    import os
+
+    from deeplearning4j_tpu.analysis import slo
+    from deeplearning4j_tpu.utils import runledger
+
+    if not os.path.exists(args.ledger):
+        print(f"ledger not found: {args.ledger}", file=sys.stderr)
+        return 2
+    doc = runledger.read_ledger(args.ledger)
+    if args.rules:
+        with open(args.rules) as f:
+            ruleset = slo.SLORuleSet.from_json(f.read())
+    else:
+        rule_dicts = doc["manifest"].get("rules") or []
+        if not rule_dicts:
+            print("ledger carries no rules (recorded without a rule "
+                  "pack) — pass --rules <json>", file=sys.stderr)
+            return 2
+        ruleset = slo.SLORuleSet.from_dicts(rule_dicts)
+    report = slo.evaluate_ledger(runledger.iter_samples(doc),
+                                 ruleset.rules)
+    report["ledger"] = args.ledger
+    report["run_id"] = doc["manifest"].get("run_id")
+    # recorded live transitions ride along so an offline/live divergence
+    # (rules changed since the run) is visible, not silent
+    report["recorded_alerts"] = list(runledger.iter_alerts(doc))
+    if args.json == "-":
+        print(_json.dumps(report, indent=2, default=str))
+    elif args.json:
+        with open(args.json, "w") as f:
+            _json.dump(report, f, indent=2, default=str)
+        print(f"wrote {args.json}")
+    else:
+        print(f"slo — run {report['run_id']} "
+              f"({report['samples']} samples, "
+              f"{len(ruleset.rules)} rules)")
+        for r in report["rules"]:
+            mark = {"firing": "!!", "pending": " ~"}.get(r["state"], "  ")
+            fired = (f"  fired x{r['fired_total']}"
+                     if r["fired_total"] else "")
+            print(f"  {mark} {r['rule']:<28} {r['state']:<8} "
+                  f"[{r['severity']}]{fired}  {r['detail']}")
+        for t in report["transitions"]:
+            print(f"    {t['ts']:.3f}  {t['rule']} -> {t['to']} "
+                  f"(value {t['value']})")
+        verdict = "ok" if report["ok"] else (
+            f"ERROR rules fired: {', '.join(report['ever_fired_errors'])}")
+        print(f"  verdict: {verdict}")
+    if args.check:
+        return 0 if report["ok"] else 1
+    return 0
+
+
+def cmd_runs(args) -> int:
+    """Run-ledger operations: list the recorded runs in a directory, or
+    `runs compare <reference> <candidate>` for per-metric regression
+    deltas between two ledgers — the bench `vs_baseline` idea
+    generalized from one-shot workloads to whole runs (counters compare
+    by rate, gauges/latency means by mean; series moving more than
+    --threshold are flagged with their metric family)."""
+    import json as _json
+
+    from deeplearning4j_tpu.utils import runledger
+
+    if args.paths and args.paths[0] == "compare":
+        if len(args.paths) != 3:
+            print("usage: runs compare <reference.jsonl> "
+                  "<candidate.jsonl>", file=sys.stderr)
+            return 2
+        import os
+
+        for p in args.paths[1:]:
+            if not os.path.exists(p):
+                print(f"ledger not found: {p}", file=sys.stderr)
+                return 2
+        ref = runledger.summarize_run(
+            runledger.read_ledger(args.paths[1]))
+        cand = runledger.summarize_run(
+            runledger.read_ledger(args.paths[2]))
+        report = runledger.compare_runs(ref, cand,
+                                        threshold=args.threshold)
+        if args.json == "-":
+            print(_json.dumps(report, indent=2, default=str))
+        elif args.json:
+            with open(args.json, "w") as f:
+                _json.dump(report, f, indent=2, default=str)
+            print(f"wrote {args.json}")
+        else:
+            print(f"compare — reference {report['reference']['run_id']} "
+                  f"vs candidate {report['candidate']['run_id']} "
+                  f"(threshold {report['threshold']:.0%})")
+            if not report["regressions"]:
+                print("  no series moved past the threshold")
+            for row in report["regressions"][:args.top]:
+                r = row["ratio"]
+                print(f"  {row['series']:<52} {row['basis']:>5} "
+                      f"{row['reference']:>12.6g} -> "
+                      f"{row['candidate']:>12.6g}  "
+                      f"x{r if r is not None else float('nan'):.3f}")
+            if report["regression_families"]:
+                print("  families moved: "
+                      + ", ".join(report["regression_families"]))
+        return 0
+    directory = args.dir or (args.paths[0] if args.paths else ".")
+    entries = runledger.list_ledgers(directory)
+    if args.json == "-":
+        print(_json.dumps(entries, indent=2, default=str))
+        return 0
+    if not entries:
+        print(f"no run ledgers in {directory!r}")
+        return 0
+    print(f"{len(entries)} run(s) in {directory}:")
+    for e in entries:
+        print(f"  {e['run_id']}  rules={e['rules']}  {e['path']}")
     return 0
 
 
@@ -1271,7 +1448,48 @@ def main(argv=None) -> int:
                         "deltas and gauge values (ctrl-C to stop)")
     m.add_argument("--watch-count", type=int, default=0,
                    help="stop after N watch ticks (0 = until ctrl-C)")
+    m.add_argument("--ledger", default=None, metavar="PATH",
+                   help="replay a recorded run ledger tick-by-tick with "
+                        "the --watch delta rendering (post-mortems "
+                        "without the process alive); --watch-count caps "
+                        "the ticks")
     m.set_defaults(fn=cmd_metrics)
+
+    sl = sub.add_parser(
+        "slo",
+        help="offline SLO re-evaluation of a recorded run ledger "
+             "(analysis/slo); --check exits 1 when ERROR rules fired — "
+             "the CI/soak gate")
+    sl.add_argument("--ledger", required=True, metavar="PATH",
+                    help="run-ledger JSONL artifact (utils/runledger)")
+    sl.add_argument("--rules", default=None, metavar="JSON",
+                    help="rule-set JSON (list of SLORule dicts, or "
+                         "{'rules': [...]}); default: the pack embedded "
+                         "in the ledger's manifest")
+    sl.add_argument("--check", action="store_true",
+                    help="exit 1 when any ERROR-severity rule fired at "
+                         "any point during the run")
+    sl.add_argument("--json", default=None, metavar="PATH",
+                    help="machine-readable report ('-' = stdout)")
+    sl.set_defaults(fn=cmd_slo)
+
+    rn = sub.add_parser(
+        "runs",
+        help="list recorded run ledgers, or `runs compare A B` for "
+             "per-metric regression deltas between two runs")
+    rn.add_argument("paths", nargs="*",
+                    help="a directory to list, or: compare "
+                         "<reference.jsonl> <candidate.jsonl>")
+    rn.add_argument("--dir", default=None,
+                    help="directory to list ledgers from (default: .)")
+    rn.add_argument("--threshold", type=float, default=0.25,
+                    help="flag series whose rate/mean ratio moves more "
+                         "than this fraction (compare)")
+    rn.add_argument("--top", type=int, default=20,
+                    help="flagged rows to print (compare)")
+    rn.add_argument("--json", default=None, metavar="PATH",
+                    help="machine-readable report ('-' = stdout)")
+    rn.set_defaults(fn=cmd_runs)
 
     tr = sub.add_parser(
         "trace",

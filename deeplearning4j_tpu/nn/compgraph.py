@@ -424,7 +424,8 @@ class ComputationGraph(NetworkBase):
 
     def fit(self, data, labels=None, *, epochs: int = 1, batch_size: int = 32,
             async_prefetch: bool = True, prefetch_buffer: int = 4,
-            hang_timeout: float = None, resume_from: str = None):
+            hang_timeout: float = None, resume_from: str = None,
+            run_ledger=None):
         """Train. Accepts (features, labels) arrays, a DataSet/MultiDataSet,
         or a DataSetIterator/MultiDataSetIterator (reference:
         ComputationGraph.fit overloads :857-867). With async_prefetch the
@@ -451,7 +452,8 @@ class ComputationGraph(NetworkBase):
             )
         return self._run_fit(iterator, epochs, async_prefetch,
                              prefetch_buffer, hang_timeout=hang_timeout,
-                             resume_from=resume_from)
+                             resume_from=resume_from,
+                             run_ledger=run_ledger)
 
     def _fit_dataset(self, ds):
         mds = _as_multidataset(ds)

@@ -615,7 +615,8 @@ class MultiLayerNetwork(NetworkBase):
 
     def fit(self, data, labels=None, *, epochs: int = 1, batch_size: int = 32,
             async_prefetch: bool = True, prefetch_buffer: int = 4,
-            hang_timeout: float = None, resume_from: str = None):
+            hang_timeout: float = None, resume_from: str = None,
+            run_ledger=None):
         """Train. Accepts (features, labels) arrays, a DataSet, or a
         DataSetIterator (reference: MultiLayerNetwork.fit overloads
         :1019). If the configuration sets pretrain=True, layerwise
@@ -635,7 +636,12 @@ class MultiLayerNetwork(NetworkBase):
         continues to the same loss curve as an uninterrupted run; an
         empty directory starts fresh, so the same command line works on
         first boot and after a preemption. `epochs` stays the TOTAL
-        target — already-completed epochs are not re-run."""
+        target — already-completed epochs are not re-run. `run_ledger`
+        opts this fit into persistent metrics recording + SLO judgment
+        (utils/runledger): a path records a per-run ledger artifact
+        there, a RunLedger instance is attached for the fit's duration;
+        None (the default) keeps the fit-loop ledger hook at one flag
+        check per step."""
         self._require_init()
         if self.conf.pretrain and not getattr(self, "_pretrained", False):
             self.pretrain(data, batch_size=batch_size)
@@ -643,7 +649,8 @@ class MultiLayerNetwork(NetworkBase):
         iterator = self._as_iterator(data, labels, batch_size)
         return self._run_fit(iterator, epochs, async_prefetch,
                              prefetch_buffer, hang_timeout=hang_timeout,
-                             resume_from=resume_from)
+                             resume_from=resume_from,
+                             run_ledger=run_ledger)
 
     def _as_iterator(self, data, labels, batch_size) -> DataSetIterator:
         if isinstance(data, DataSetIterator):

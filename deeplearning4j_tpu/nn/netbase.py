@@ -30,6 +30,7 @@ from deeplearning4j_tpu.utils import devprof as _devprof
 from deeplearning4j_tpu.utils import faultpoints as _faults
 from deeplearning4j_tpu.utils import health as _health
 from deeplearning4j_tpu.utils import metrics as _metrics
+from deeplearning4j_tpu.utils import runledger as _runledger
 from deeplearning4j_tpu.utils import tracing as _tracing
 
 logger = logging.getLogger("deeplearning4j_tpu")
@@ -556,6 +557,10 @@ class NetworkBase:
         # device-side accounting: two integer ops on unsampled steps,
         # one blocking score read every sample_every-th (utils/devprof)
         ins["devprof"].on_step(self, n_examples, self._score)
+        # run-ledger hook: ONE module-global read with no ledger
+        # attached (the off-by-default overhead contract); sampling
+        # itself lives on the ledger's own daemon, never here
+        _runledger.note_fit_step(self)
         hb = self._fit_heartbeat
         if hb is not None:
             hb.beat()
@@ -579,7 +584,19 @@ class NetworkBase:
     def _run_fit(self, iterator, epochs: int, async_prefetch: bool,
                  prefetch_buffer: int = 4,
                  hang_timeout: Optional[float] = None,
-                 resume_from: Optional[str] = None):
+                 resume_from: Optional[str] = None,
+                 run_ledger=None):
+        # run-ledger opt-in (ONE knob): a path builds a RunLedger there
+        # (closed when the fit ends — the per-run artifact), an instance
+        # is attached for the fit's duration and left open for its
+        # owner. Hooks stay a single flag check when this is None.
+        owned_ledger = attached_ledger = None
+        if run_ledger is not None:
+            if isinstance(run_ledger, str):
+                owned_ledger = _runledger.RunLedger(run_ledger)
+                attached_ledger = _runledger.attach(owned_ledger)
+            else:
+                attached_ledger = _runledger.attach(run_ledger)
         # multi-device default: engage the sharded data-parallel step
         # BEFORE restore/staging so the restored state lands on the mesh
         # and the pipeline stages batches with the mesh sharding
@@ -648,6 +665,13 @@ class NetworkBase:
                              "dump at %s", path)
             raise
         finally:
+            # the ledger scope ends with the fit: an owned (path-built)
+            # ledger takes its final sample and closes; a caller-owned
+            # one is only detached (its recording thread lives on)
+            if owned_ledger is not None:
+                owned_ledger.close()
+            elif attached_ledger is not None:
+                _runledger.detach(attached_ledger)
             self._fit_heartbeat = None
             # resume coordinates die with the fit: a preemption save
             # AFTER a completed fit must record a clean epoch boundary,

@@ -87,6 +87,7 @@ from deeplearning4j_tpu.utils import blackbox as _blackbox
 from deeplearning4j_tpu.utils import faultpoints as _faults
 from deeplearning4j_tpu.utils import health as _health
 from deeplearning4j_tpu.utils import metrics as _metrics
+from deeplearning4j_tpu.utils import runledger as _runledger
 from deeplearning4j_tpu.utils import tracing as _tracing
 from deeplearning4j_tpu.utils.concurrency import (
     QueueAborted,
@@ -217,8 +218,21 @@ class ParallelInference:
         component_prefix: str = "serving",
         queue_capacity: int = 1024,
         default_deadline_ms: Optional[float] = None,
+        run_ledger=None,
     ):
         self.model = model
+        # run-ledger opt-in (ONE knob, same contract as fit()): a path
+        # builds a RunLedger there (closed at shutdown — the per-run
+        # artifact); an instance is attached and left open for its
+        # owner. None keeps the serving hook at one flag check.
+        self._owned_ledger = self._attached_ledger = None
+        if run_ledger is not None:
+            if isinstance(run_ledger, str):
+                self._owned_ledger = _runledger.RunLedger(run_ledger)
+                self._attached_ledger = _runledger.attach(
+                    self._owned_ledger)
+            else:
+                self._attached_ledger = _runledger.attach(run_ledger)
         self.mesh = mesh if mesh is not None else data_parallel_mesh()
         self.mode = inference_mode
         self.max_batch_size = int(max_batch_size)
@@ -342,6 +356,15 @@ class ParallelInference:
         self._m_completed = reg.counter(
             "serving_completed_total",
             "admitted requests resolved with a result").labels()
+        # completed-request latency at THIS layer (admission to result),
+        # below any HTTP front-end: the histogram the SLO burn-rate
+        # objective ("99% of requests under default_deadline_ms",
+        # analysis/slo) judges from its bucket counts — sheds never
+        # observe here, so the objective grades what was actually served
+        self._m_output_latency = reg.histogram(
+            "serving_output_seconds",
+            "ParallelInference.output latency of completed requests "
+            "(admission to result; sheds/failures excluded)").labels()
         self._m_failed = reg.counter(
             "serving_failed_total",
             "admitted requests resolved with an error "
@@ -389,6 +412,17 @@ class ParallelInference:
         `default_deadline_ms`; None = no deadline): a request that
         cannot make it is shed — DeadlineExceeded / RequestRejected —
         instead of served late."""
+        # run-ledger hook first (one global read when no ledger is
+        # attached), then the end-to-end latency of COMPLETED requests
+        # into serving_output_seconds — sheds raise out of _output_impl
+        # and never observe, so the SLO objective judges served work
+        _runledger.note_request()
+        t0 = time.perf_counter()
+        out = self._output_impl(x, deadline_ms)
+        self._m_output_latency.observe(time.perf_counter() - t0)
+        return out
+
+    def _output_impl(self, x, deadline_ms: Optional[float] = None):
         xx = np.asarray(x)
         if deadline_ms is None:
             deadline_ms = self.default_deadline_ms
@@ -743,6 +777,13 @@ class ParallelInference:
         for hb in (self._hb_collect, self._hb_dispatch):
             if hb is not None:
                 _health.get_health().unregister(hb)
+        # the serving ledger scope ends AFTER the drain/joins: the
+        # owned ledger's final sample must see the end-of-run books
+        # (in-flight futures resolved), not a mid-drain truncation
+        if self._owned_ledger is not None:
+            self._owned_ledger.close()
+        elif self._attached_ledger is not None:
+            _runledger.detach(self._attached_ledger)
         if not workers_exited:
             # a slow in-flight forward (e.g. first compile) outlived the
             # join timeout: the pipeline is still draining and will resolve

@@ -35,6 +35,11 @@ device execution). Routes:
                       helper_* counters living in the same process
     GET  /trace    -> recent host spans as JSONL (utils/tracing.py);
                       ?format=chrome returns a chrome://tracing document
+    GET  /alerts   -> live SLO rule states from the attached run ledger
+                      (utils/runledger + analysis/slo): per-rule
+                      pending/firing lifecycle, recent transitions —
+                      machine-readable verdicts, not just gauges
+                      (start with --ledger or run_ledger=)
 
 Knobs (constructor and CLI flags): `max_batch_size`, `batch_timeout_ms`,
 `buckets`, `warmup_shape` (precompiles every bucket before the port
@@ -63,6 +68,7 @@ from deeplearning4j_tpu.parallel.inference import (
 )
 from deeplearning4j_tpu.utils import health as _health
 from deeplearning4j_tpu.utils import metrics as _metrics
+from deeplearning4j_tpu.utils import runledger as _runledger
 from deeplearning4j_tpu.utils import tracing as _tracing
 from deeplearning4j_tpu.utils.jsonhttp import JsonHttpServer, json_response
 from deeplearning4j_tpu.utils.latency import LatencyTracker
@@ -86,6 +92,7 @@ class InferenceServer:
         queue_capacity: int = 1024,
         default_deadline_ms: Optional[float] = None,
         request_timeout: float = 30.0,
+        run_ledger=None,
     ):
         # n_replicas >= 2 turns on the self-healing pool: each replica's
         # collector/dispatcher heartbeats are watched separately, an
@@ -114,6 +121,26 @@ class InferenceServer:
             )
         if warmup_shape is not None:
             self.inference.warmup(warmup_shape)
+        # run-ledger opt-in at the server level (works for both the
+        # single-PI and ReplicaPool modes): a path builds a RunLedger
+        # with the default rule pack derived from THIS server's config
+        # (the p99 deadline burn objective, queue boundedness) and
+        # closes it on stop(); an instance is attached as given.
+        self._owned_ledger = self._attached_ledger = None
+        if run_ledger is not None:
+            if isinstance(run_ledger, str):
+                from deeplearning4j_tpu.analysis.slo import default_rule_pack
+
+                self._owned_ledger = _runledger.RunLedger(
+                    run_ledger,
+                    rules=default_rule_pack(serving={
+                        "default_deadline_ms": default_deadline_ms,
+                        "queue_capacity": queue_capacity,
+                    }))
+                self._attached_ledger = _runledger.attach(
+                    self._owned_ledger)
+            else:
+                self._attached_ledger = _runledger.attach(run_ledger)
         self.latency = LatencyTracker()
         # request latency also lands in the shared registry so one
         # Prometheus scrape carries serving AND training series
@@ -178,6 +205,25 @@ class InferenceServer:
                 # `cli metrics --watch --url` diffs per tick
                 return json_response(_metrics.get_registry().snapshot())
             return json_response(self.metrics())
+        if route == "/alerts":
+            # the live SLO verdicts (analysis/slo evaluated on the run
+            # ledger's recorder thread): per-rule pending/firing state,
+            # recent lifecycle transitions, and which rules fire right
+            # now — machine-readable, the scrape a soak gate or the
+            # autotune controller polls instead of eyeballing gauges
+            # THIS server's ledger first: another component attaching/
+            # detaching the process-global slot (a fit's scoped ledger
+            # ending mid-serve) must not hijack or blank this endpoint
+            led = (self._owned_ledger or self._attached_ledger
+                   or _runledger.current())
+            if led is None:
+                return json_response({
+                    "ledger": None, "rules": [], "firing": [],
+                    "transitions": [],
+                    "note": "no run ledger attached (start the server "
+                            "with run_ledger=, or attach one via "
+                            "utils.runledger)"})
+            return json_response(led.alert_status())
         if route == "/trace":
             # recent host spans — JSONL by default (tail-able), or the
             # chrome://tracing document with ?format=chrome
@@ -288,6 +334,10 @@ class InferenceServer:
     def stop(self):
         self._server.stop()
         self.inference.shutdown()
+        if self._owned_ledger is not None:
+            self._owned_ledger.close()
+        elif self._attached_ledger is not None:
+            _runledger.detach(self._attached_ledger)
 
     def join(self):
         self._server.join()
@@ -324,6 +374,10 @@ def main(argv=None):
     ap.add_argument("--requestTimeout", type=float, default=30.0,
                     help="per-connection socket read timeout (slowloris "
                          "protection); 0 disables")
+    ap.add_argument("--ledger", default=None, metavar="PATH",
+                    help="record a persistent run ledger (metrics "
+                         "samples + SLO rule verdicts) to this path; "
+                         "GET /alerts serves the live rule states")
     args = ap.parse_args(argv)
     from deeplearning4j_tpu.cli import guess_and_load_model
 
@@ -339,6 +393,7 @@ def main(argv=None):
         queue_capacity=args.queueCapacity,
         default_deadline_ms=args.defaultDeadlineMs,
         request_timeout=args.requestTimeout,
+        run_ledger=args.ledger,
     )
     # operator surface: opt in to real log output, then announce through
     # the package logger (library code never prints — lint CC006)

@@ -43,7 +43,8 @@ _PAGE = """<!doctype html>
 <nav><a href="/train/overview">overview</a><a href="/train/model">model</a>
 <a href="/train/flow">flow</a>
 <a href="/train/system">system</a><a href="/train/histogram">histogram</a>
-<a href="/train/activations">activations</a><a href="/tsne">tsne</a></nav>
+<a href="/train/activations">activations</a><a href="/train/alerts">alerts</a>
+<a href="/tsne">tsne</a></nav>
 <h1>dl4j-tpu training — {title}</h1>
 <div id="content">loading…</div>
 <script>
@@ -121,6 +122,32 @@ async function refresh() {{
     }}
   }} else if (VIEW == "flow") {{
     html += `<div class="chart">${{d.svg || "(no graph yet)"}}</div>`;
+  }} else if (VIEW == "alerts") {{
+    if (!d.ledger) {{
+      html += `<p>${{d.note || "no run ledger attached"}}</p>`;
+    }} else {{
+      html += `<p>run <code>${{d.run_id}}</code> — ledger
+               <code>${{d.ledger}}</code></p>`;
+      html += "<table><tr><th>rule</th><th>state</th><th>severity</th>"
+            + "<th>value</th><th>definition</th></tr>";
+      for (const r of d.rules || []) {{
+        const color = r.state == "firing" ? "#c62828"
+                    : r.state == "pending" ? "#ef6c00" : "#2e7d32";
+        html += `<tr><td>${{r.rule}}</td>
+                 <td style="color:${{color}}"><b>${{r.state}}</b></td>
+                 <td>${{r.severity}}</td><td>${{r.value ?? ""}}</td>
+                 <td>${{r.detail}}</td></tr>`;
+      }}
+      html += "</table>";
+      if ((d.transitions || []).length) {{
+        html += "<h2>recent transitions</h2><table>"
+              + "<tr><th>ts</th><th>rule</th><th>to</th><th>value</th></tr>";
+        for (const t of d.transitions.slice(-20).reverse())
+          html += `<tr><td>${{t.ts}}</td><td>${{t.rule}}</td>
+                   <td>${{t.to}}</td><td>${{t.value ?? ""}}</td></tr>`;
+        html += "</table>";
+      }}
+    }}
   }} else if (VIEW == "tsne") {{
     const W = 760, H = 560;
     let pts = "";
@@ -147,6 +174,8 @@ async function refresh() {{
     html += "</table>";
     for (const [dev, pts] of Object.entries(d.memory || {{}}))
       html += chart(dev + " bytes in use", pts, "#ef6c00");
+    for (const [name, pts] of Object.entries(d.live || {{}}))
+      html += chart(name, pts, "#00838f");
   }}
   document.getElementById("content").innerHTML = html;
 }}
@@ -162,6 +191,20 @@ class UIServer:
     def __init__(self, storage: StatsStorage, port: int = 9090):
         self.storage = storage
         self._tsne = {"words": [], "coords": []}
+        # live registry gauge history for the system view: sampled once
+        # per /train/system/data poll (the dashboard's own 2s cadence —
+        # no extra thread), bounded per series. This is what makes the
+        # PR 9 headline gauges (step_mfu, step_flops_per_second,
+        # device_memory_bytes{kind}) and the serving queue depth visible
+        # in the UI instead of only in a Prometheus scrape.
+        self._sys_hist: dict = {}
+        self._sys_t0 = None
+        # JsonHttpServer handles requests on multiple threads: the
+        # history dict is mutated per poll and must not be iterated
+        # concurrently with an insert
+        import threading
+
+        self._sys_lock = threading.Lock()
         self._server = JsonHttpServer(get=self._get, post=self._post,
                                       port=port)
 
@@ -254,6 +297,36 @@ class UIServer:
             layers.append({**meta, "series": series})
         return {"session": session, "layers": layers}
 
+    # registry families charted on the system page (exact family names;
+    # every labeled child becomes its own series)
+    _SYSTEM_GAUGES = ("step_mfu", "step_flops_per_second",
+                      "step_device_seconds", "device_memory_bytes",
+                      "serving_queue_depth")
+
+    def _sample_system_gauges(self) -> dict:
+        """Append the live devprof/serving gauges to the bounded
+        per-series history and return {series: [[t, v], ...]} — called
+        from the data route, so history advances at the dashboard's own
+        poll cadence and costs nothing when nobody is watching."""
+        import time
+
+        from deeplearning4j_tpu.utils.metrics import get_registry
+
+        try:
+            scalars = get_registry().scalar_values()
+        except Exception:
+            scalars = {}
+        with self._sys_lock:
+            if self._sys_t0 is None:
+                self._sys_t0 = time.time()
+            t = round(time.time() - self._sys_t0, 1)
+            for key, v in scalars.items():
+                if key.split("{")[0] in self._SYSTEM_GAUGES:
+                    hist = self._sys_hist.setdefault(key, [])
+                    hist.append([t, v])
+                    del hist[:-300]  # bounded: ~10 min at the 2s poll
+            return {k: list(v) for k, v in self._sys_hist.items()}
+
     def _system_data(self, session: Optional[str]) -> dict:
         ups = self.storage.get_updates(session) if session else []
         static = (self.storage.get_static_info(session) or {}) if session else {}
@@ -262,7 +335,22 @@ class UIServer:
             for dev, m in (u.get("memory") or {}).items():
                 memory.setdefault(dev, []).append(
                     [u["iteration"], m.get("bytes_in_use", 0)])
-        return {"session": session, "static": static, "memory": memory}
+        return {"session": session, "static": static, "memory": memory,
+                "live": self._sample_system_gauges()}
+
+    def _alerts_data(self) -> dict:
+        """Live SLO rule states from the attached run ledger (the same
+        payload as the inference server's GET /alerts)."""
+        from deeplearning4j_tpu.utils import runledger
+
+        led = runledger.current()
+        if led is None:
+            return {"ledger": None, "rules": [], "firing": [],
+                    "transitions": [],
+                    "note": "no run ledger attached — pass "
+                            "run_ledger= to fit()/the server, or "
+                            "attach one via utils.runledger"}
+        return led.alert_status()
 
     # -- http ----------------------------------------------------------------
 
@@ -274,6 +362,7 @@ class UIServer:
                  "/train/histogram": "histogram",
                  "/train/activations": "activations",
                  "/train/flow": "flow",
+                 "/train/alerts": "alerts",
                  "/tsne": "tsne", "/train/tsne": "tsne"}
         if path in pages:
             view = pages[path]
@@ -311,6 +400,8 @@ class UIServer:
             return json_response({"layers": st.get("layers", [])})
         if path == "/train/system/data":
             return json_response(self._system_data(session))
+        if path == "/train/alerts/data":
+            return json_response(self._alerts_data())
         if path == "/train/sessions/current":
             return json_response({"session": session})
         if path == "/train/sessions/all":

@@ -113,22 +113,57 @@ class InMemoryStatsStorage(StatsStorage):
         return [u for u in ups if u.get("iteration", 0) > since_iteration]
 
 
+def _downsample_oldest(rows: List[dict], cap: int) -> List[dict]:
+    """Retention/compaction policy shared by the durable stats stores:
+    keep the NEWEST `cap // 2` rows raw and thin the older remainder by
+    uniform stride so the total lands back at <= `cap` — history keeps
+    its full time extent at reduced resolution while recent records stay
+    exact (the rollup idea from utils/runledger, applied to the
+    reference's unbounded StatsStorage). The newest row always survives
+    and order is preserved, so `get_updates(since_iteration=...)`
+    answers consistently on a capped store."""
+    if len(rows) <= cap:
+        return rows
+    tail_n = max(1, cap // 2)
+    head, tail = rows[:-tail_n], rows[-tail_n:]
+    keep_n = max(1, cap - tail_n)
+    stride = max(1, -(-len(head) // keep_n))  # ceil division
+    return head[::stride] + tail
+
+
 class FileStatsStorage(StatsStorage):
     """Append-only log: [u8 kind][u16 session_len][session utf8]
     [u32 payload_len][payload] where kind 0 = static JSON, 1 = binary
     update record. Cold-readable — open an existing path to browse a
-    finished run (the dashboard does exactly this)."""
+    finished run (the dashboard does exactly this).
+
+    `max_updates_per_session` bounds the per-session update rows: past
+    the cap the OLDEST records are downsampled (uniform stride over the
+    older half; the newest half stays raw) and the log is compacted via
+    tmp + os.replace — a reference FileStatsStorage fed by a week-long
+    soak grows without bound; this one converges to ~cap rows per
+    session. 0/None disables (the reference behavior)."""
 
     _KIND_STATIC = 0
     _KIND_UPDATE = 1
 
-    def __init__(self, path: str):
+    def __init__(self, path: str,
+                 max_updates_per_session: Optional[int] = None):
         self.path = path
+        self.max_updates_per_session = (
+            int(max_updates_per_session) if max_updates_per_session
+            else None)
+        if self.max_updates_per_session is not None \
+                and self.max_updates_per_session < 2:
+            raise ValueError("max_updates_per_session must be >= 2")
         self._lock = threading.Lock()
         self._static: Dict[str, dict] = {}
         self._updates: Dict[str, List[dict]] = {}
         if os.path.exists(path):
             self._load()
+            if self.max_updates_per_session is not None:
+                with self._lock:
+                    self._compact_locked()
         else:
             open(path, "wb").close()
 
@@ -168,10 +203,41 @@ class FileStatsStorage(StatsStorage):
     def put_update(self, session_id, record):
         encoded = encode_record(record)
         with self._lock:
-            self._updates.setdefault(session_id, []).append(
-                decode_record(encoded))
+            rows = self._updates.setdefault(session_id, [])
+            rows.append(decode_record(encoded))
             self._append(self._KIND_UPDATE, session_id, encoded)
+            cap = self.max_updates_per_session
+            if cap is not None and len(rows) > cap + cap // 2:
+                # compact only past cap*1.5, so the rewrite amortizes
+                # over cap/2 appends instead of running per record
+                self._compact_locked()
         self._notify(session_id, record)
+
+    def _compact_locked(self):
+        cap = self.max_updates_per_session
+        changed = False
+        for sid, rows in self._updates.items():
+            if len(rows) > cap:
+                self._updates[sid] = _downsample_oldest(rows, cap)
+                changed = True
+        if not changed:
+            return
+        tmp = f"{self.path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            for sid, info in self._static.items():
+                payload = json.dumps(info).encode()
+                sb = sid.encode()
+                f.write(bytes([self._KIND_STATIC])
+                        + struct.pack("<H", len(sb)) + sb
+                        + struct.pack("<I", len(payload)) + payload)
+            for sid, rows in self._updates.items():
+                sb = sid.encode()
+                for u in rows:
+                    payload = encode_record(u)
+                    f.write(bytes([self._KIND_UPDATE])
+                            + struct.pack("<H", len(sb)) + sb
+                            + struct.pack("<I", len(payload)) + payload)
+        os.replace(tmp, self.path)
 
     def list_session_ids(self):
         with self._lock:
@@ -197,10 +263,20 @@ class SqliteStatsStorage(StatsStorage):
     opening a million-record run does not re-parse a million records.
     stdlib sqlite3, same binary record codec as the file store."""
 
-    def __init__(self, path: str):
+    def __init__(self, path: str,
+                 max_updates_per_session: Optional[int] = None):
         import sqlite3
 
         self.path = path
+        # same retention contract as FileStatsStorage: past the cap,
+        # the oldest rows per session are downsampled by uniform stride
+        # (DELETE by rowid — no file rewrite needed here)
+        self.max_updates_per_session = (
+            int(max_updates_per_session) if max_updates_per_session
+            else None)
+        if self.max_updates_per_session is not None \
+                and self.max_updates_per_session < 2:
+            raise ValueError("max_updates_per_session must be >= 2")
         self._lock = threading.Lock()
         self._db = sqlite3.connect(path, check_same_thread=False)
         # WAL + NORMAL: per-record commits without a per-record fsync —
@@ -235,8 +311,32 @@ class SqliteStatsStorage(StatsStorage):
                 "INSERT INTO updates VALUES (?, ?, ?, ?)",
                 (session_id, int(record.get("iteration", 0)),
                  float(record.get("ts", 0.0)), encoded))
+            cap = self.max_updates_per_session
+            if cap is not None:
+                n = self._db.execute(
+                    "SELECT COUNT(*) FROM updates WHERE session = ?",
+                    (session_id,)).fetchone()[0]
+                if n > cap + cap // 2:
+                    self._compact_session_locked(session_id, n)
             self._db.commit()
         self._notify(session_id, record)
+
+    def _compact_session_locked(self, session_id: str, n: int):
+        """Oldest-first downsample to <= cap rows: the newest cap//2
+        stay raw, the older remainder keeps every stride-th row (rowid
+        order == insertion order) — same policy as FileStatsStorage's
+        _downsample_oldest, expressed as a DELETE."""
+        cap = self.max_updates_per_session
+        rowids = [r[0] for r in self._db.execute(
+            "SELECT rowid FROM updates WHERE session = ?"
+            " ORDER BY iteration, rowid", (session_id,))]
+        tail_n = max(1, cap // 2)
+        head = rowids[:-tail_n]
+        keep_n = max(1, cap - tail_n)
+        stride = max(1, -(-len(head) // keep_n))
+        keep = set(head[::stride])
+        drop = [(rid,) for rid in head if rid not in keep]
+        self._db.executemany("DELETE FROM updates WHERE rowid = ?", drop)
 
     def list_session_ids(self):
         with self._lock:

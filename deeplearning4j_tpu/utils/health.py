@@ -191,6 +191,11 @@ class HealthRegistry:
     def __init__(self):
         self._lock = threading.RLock()  # see Heartbeat._lock
         self._components: Dict[str, Heartbeat] = {}
+        # externally-asserted conditions (analysis/slo firing rules mark
+        # their owning component DEGRADED here): name -> {state, reason,
+        # since}. Merged with the heartbeat view in status() — the worst
+        # of the two wins — and cleared by set_condition(name, OK).
+        self._conditions: Dict[str, dict] = {}
         self._transitions: deque = deque(maxlen=256)
         self._seq = 0
         self._listeners: List[Callable] = []
@@ -245,7 +250,75 @@ class HealthRegistry:
                 return
         if hb.state != OK:  # leave no stuck gauge behind
             self._record_transition(hb, OK, 0.0, [])
-        self._gauge.labels(hb.name).set(0)
+        with self._lock:
+            cond = self._conditions.get(hb.name)
+        self._gauge.labels(hb.name).set(
+            _LEVEL[cond["state"]] if cond else 0)
+
+    # -- externally-asserted conditions ---------------------------------------
+
+    def set_condition(self, component: str, state: str, reason: str = ""):
+        """Assert a component's health from OUTSIDE the watchdog model —
+        the hook SLO rules (analysis/slo via utils/runledger) use to mark
+        an owning component DEGRADED while a rule fires, and to clear it
+        on resolve (`state=OK`). Conditions merge with the busy-slot
+        view: `status()` and the `component_health` gauge report the
+        WORST of the heartbeat state and the asserted condition, so a
+        firing latency rule degrades "serving" even while its pipeline
+        threads are individually live. Each level change records a
+        transition (kind="condition") through the same history/listener/
+        flight-recorder path as watchdog transitions."""
+        if state not in _LEVEL:
+            raise ValueError(f"unknown health state {state!r}")
+        with self._lock:
+            prev = self._conditions.get(component)
+            old = prev["state"] if prev else OK
+            if state == old and prev is not None:
+                prev["reason"] = reason  # refresh, no transition
+                return
+            if state == OK:
+                self._conditions.pop(component, None)
+                if prev is None:
+                    return  # clearing a condition never asserted: no-op
+            else:
+                self._conditions[component] = {
+                    "state": state, "reason": reason, "since": time.time()}
+            self._seq += 1
+            tr = {
+                "seq": self._seq,
+                "ts": time.time(),
+                "component": component,
+                "from": old,
+                "to": state,
+                "kind": "condition",
+                "reason": reason,
+            }
+            self._transitions.append(tr)
+            listeners = list(self._listeners)
+            hb = self._components.get(component)
+        # the gauge reports the MERGED level — a heartbeat-OK component
+        # with a DEGRADED condition reads 1, and clearing the condition
+        # falls back to the heartbeat's own state, not blindly to 0
+        hb_level = _LEVEL[hb.state] if hb is not None else 0
+        self._gauge.labels(component).set(max(hb_level, _LEVEL[state]))
+        try:
+            from deeplearning4j_tpu.utils import blackbox
+
+            blackbox.get_recorder().record_event(
+                "health_condition", component=component, frm=old, to=state,
+                reason=reason)
+        except Exception:
+            logger.exception("flight-recorder condition event failed")
+        for fn in listeners:
+            try:
+                fn(tr)
+            except Exception:
+                logger.exception("health transition listener failed")
+
+    def get_condition(self, component: str) -> Optional[dict]:
+        with self._lock:
+            c = self._conditions.get(component)
+            return dict(c) if c else None
 
     def add_listener(self, fn: Callable[[dict], None]):
         """`fn(transition_dict)` on every health transition — the hook
@@ -267,18 +340,34 @@ class HealthRegistry:
         slots (not the last scan), so recovery is visible immediately."""
         with self._lock:
             comps = dict(self._components)
+            conds = {k: dict(v) for k, v in self._conditions.items()}
         now = time.monotonic()
         out, worst = {}, OK
         for name, hb in sorted(comps.items()):
             state, age, stale = hb.check(now)
-            if _LEVEL[state] > _LEVEL[worst]:
-                worst = state
             detail = {"status": state,
                       "stall_after_seconds": hb.stall_after}
             if state != OK:
                 detail["stalled_for_seconds"] = round(age, 3)
                 detail["stalled_threads"] = _thread_names(stale)
+            cond = conds.pop(name, None)
+            if cond is not None:
+                # an asserted condition (SLO rule firing) merges with the
+                # heartbeat view: worst state wins, the condition detail
+                # rides along so /health names the judging rule
+                detail["condition"] = cond
+                if _LEVEL[cond["state"]] > _LEVEL[state]:
+                    state = cond["state"]
+                    detail["status"] = state
+            if _LEVEL[state] > _LEVEL[worst]:
+                worst = state
             out[name] = detail
+        for name, cond in sorted(conds.items()):
+            # condition-only components (no heartbeat): still first-class
+            state = cond["state"]
+            out[name] = {"status": state, "condition": cond}
+            if _LEVEL[state] > _LEVEL[worst]:
+                worst = state
         return {"status": worst, "components": out}
 
     def transitions_since(self, seq: int = 0) -> List[dict]:
@@ -372,7 +461,12 @@ class HealthRegistry:
             }
             self._transitions.append(tr)
             listeners = list(self._listeners)
-        self._gauge.labels(hb.name).set(_LEVEL[state])
+            cond = self._conditions.get(hb.name)
+        # merged with any asserted condition: a watchdog recovery must
+        # not zero the gauge while an SLO rule still holds the component
+        # DEGRADED (and vice versa — see set_condition)
+        cond_level = _LEVEL[cond["state"]] if cond else 0
+        self._gauge.labels(hb.name).set(max(_LEVEL[state], cond_level))
         try:
             from deeplearning4j_tpu.utils import blackbox
 

@@ -423,12 +423,19 @@ class MetricsRegistry:
                              "values": values}
         return out
 
-    def scalar_values(self) -> Dict[str, float]:
+    def scalar_values(self, include_buckets: bool = False) -> Dict[str, float]:
         """Flat {series: value} view of every family — counters/gauges by
         value, histograms by `:count`/`:sum` — with labels rendered into
-        the key. Deliberately cheap (no percentile sorting, no bucket
-        walk): the flight recorder captures deltas of this on the fit
-        hot path, and `cli metrics --watch` diffs it per tick."""
+        the key. Deliberately cheap (no percentile sorting; no bucket
+        walk by default): the flight recorder captures deltas of this on
+        the fit hot path, and `cli metrics --watch` diffs it per tick.
+
+        `include_buckets=True` additionally emits each histogram's
+        cumulative bucket counts as `name{labels}:bucket:<le>` series —
+        the run ledger samples with this on so offline SLO burn-rate
+        rules (analysis/slo) can recover "requests under threshold"
+        from a recorded artifact. The flight recorder and the watch
+        loop stay on the cheap default."""
         with self._lock:
             fams = list(self._families.values())
         out: Dict[str, float] = {}
@@ -443,6 +450,10 @@ class MetricsRegistry:
                 if fam.kind == "histogram":
                     out[f"{fam.name}{lab}:count"] = float(child.count)
                     out[f"{fam.name}{lab}:sum"] = float(child.sum)
+                    if include_buckets:
+                        for le, c in child.cumulative_buckets():
+                            out[f"{fam.name}{lab}:bucket:{_fmt(le)}"] = \
+                                float(c)
                 else:
                     v = float(child.value)
                     if math.isfinite(v):

@@ -76,6 +76,11 @@ if [ "$rc" -gt 1 ]; then
     else
         echo "T1 BLACKBOX: no artifact at $blackbox (session died before the hooks armed?)"
     fi
+    # a wedged session's ledger still holds everything sampled up to the
+    # kill — the metric trajectory INTO the failure
+    if [ -n "${T1_LEDGER_DUMP:-}" ] && [ -f "${T1_LEDGER_ARTIFACT:-/tmp/_t1_ledger.jsonl}" ]; then
+        echo "T1 LEDGER: ${T1_LEDGER_ARTIFACT:-/tmp/_t1_ledger.jsonl} (replay: python -m deeplearning4j_tpu.cli metrics --ledger ${T1_LEDGER_ARTIFACT:-/tmp/_t1_ledger.jsonl})"
+    fi
     exit "$rc"
 fi
 new_failures=$(comm -13 <(sort -u "$baseline") "$artifact")
@@ -92,6 +97,13 @@ fi
 # with `python -m deeplearning4j_tpu.cli trace <artifact>`.
 if [ -n "${T1_TRACE_DUMP:-}" ]; then
     echo "T1 trace dump: ${T1_TRACE_ARTIFACT:-/tmp/_t1_trace.jsonl}"
+fi
+# T1_LEDGER_DUMP=1 makes tests/conftest.py record the whole session's
+# metrics-registry trajectory as a run-ledger artifact
+# (T1_LEDGER_ARTIFACT, default /tmp/_t1_ledger.jsonl) — replay with
+# `python -m deeplearning4j_tpu.cli metrics --ledger <artifact>`.
+if [ -n "${T1_LEDGER_DUMP:-}" ]; then
+    echo "T1 ledger dump: ${T1_LEDGER_ARTIFACT:-/tmp/_t1_ledger.jsonl}"
 fi
 # surface the conftest thread-leak guard's session verdict (each leak also
 # failed its test above — this is the at-a-glance summary)

@@ -60,6 +60,24 @@ from deeplearning4j_tpu.utils import devprof as _devprof  # noqa: E402
 
 _devprof.configure(sample_every=0)
 
+# Opt-in session run ledger (scripts/t1.sh T1_LEDGER_DUMP=1): record the
+# shared metrics registry's trajectory over the whole pytest session to
+# a per-run artifact (utils/runledger), next to the metrics/trace dumps
+# — replay with `cli metrics --ledger <artifact>`. The ledger's own
+# dl4j-ledger daemon is excluded from the thread-leak guard below (it
+# legitimately spans every test); ledgers that TESTS create are not.
+_t1_ledger = None
+if os.environ.get("T1_LEDGER_DUMP"):
+    from deeplearning4j_tpu.utils import runledger as _t1_runledger
+
+    _t1_ledger = _t1_runledger.RunLedger(
+        os.environ.get("T1_LEDGER_ARTIFACT", "/tmp/_t1_ledger.jsonl"),
+        sample_every=5.0,
+        manifest={"run_id": "t1-session"})
+    _t1_ledger.start()  # record only — not attach()ed, so the fit/
+    # serving hooks stay on their no-ledger path and the overhead
+    # guard tests measure what production measures
+
 # Opt-in trace artifact (scripts/t1.sh T1_TRACE_DUMP=1): accumulate every
 # span any tracing-enabled test records into one session JSONL, next to
 # the metrics dump. Tests deliberately clear the global ring in their
@@ -121,8 +139,16 @@ def _live_pipeline_threads():
 
     from deeplearning4j_tpu.data.iterators import PIPELINE_THREAD_PREFIX
 
+    # the ledger recorder daemon (utils/runledger, dl4j-ledger-*) is
+    # held to the same contract as pipeline workers: a test that starts
+    # a RunLedger must close() it (which unregisters the heartbeat and
+    # joins the thread). The session-scoped T1_LEDGER_DUMP ledger is
+    # exempt — it deliberately spans the whole run.
+    session_ledger_thread = getattr(_t1_ledger, "_thread", None)
     return sorted(((t, t.name) for t in threading.enumerate()
-                   if t.name.startswith(PIPELINE_THREAD_PREFIX)
+                   if (t.name.startswith(PIPELINE_THREAD_PREFIX)
+                       or t.name.startswith("dl4j-ledger"))
+                   and t is not session_ledger_thread
                    and t.is_alive()
                    and t not in _REPORTED_LEAKED_THREADS),
                   key=lambda pair: pair[1])
@@ -220,6 +246,16 @@ def pytest_sessionfinish(session, exitstatus):
         except Exception as e:  # an artifact failure must not fail the
             # suite
             print(f"[conftest] trace dump failed: {e}", file=sys.stderr)
+
+    # Opt-in session run ledger (scripts/t1.sh T1_LEDGER_DUMP=1): final
+    # sample + close, so the artifact ends with the session's last
+    # registry state (replay: cli metrics --ledger <artifact>).
+    if _t1_ledger is not None:
+        try:
+            _t1_ledger.close()
+        except Exception as e:  # an artifact failure must not fail the
+            # suite
+            print(f"[conftest] ledger dump failed: {e}", file=sys.stderr)
 
     # Opt-in observability artifact (scripts/t1.sh T1_METRICS_DUMP=1):
     # dump the process-global metrics registry after the run so compile
