@@ -40,6 +40,14 @@ SLO rules (analysis/slo evaluated on the run ledger, utils/runledger):
          rule's own: a burning latency objective is an error, an
          MFU-below-roofline drift a warning)
 
+divergence sentinel (train/sentinel judging each optimizer step):
+  SN001  a numerically anomalous optimizer step — non-finite loss/grad
+         norm, or grad norm > k x the rolling median (warning: the
+         step was quarantined; error: training diverged past the
+         bounded rollback budget). Collected on the sentinel
+         (`DivergenceSentinel.findings`), same record shape as every
+         other pass.
+
 concurrency lint (AST over the repo itself):
   CC001  bare `except:`
   CC002  queue put/get without timeout/abort in thread code

@@ -427,7 +427,8 @@ class SLORuleSet:
 # -- the default rule pack -----------------------------------------------------
 
 def default_rule_pack(cost_model=None, serving: Optional[dict] = None,
-                      sample_every: float = 5.0) -> List[SLORule]:
+                      sample_every: float = 5.0,
+                      grad_norm_rate: float = 10.0) -> List[SLORule]:
     """Standing rules derived from what this process attached:
 
     * serving (dict with `default_deadline_ms` / `queue_capacity` /
@@ -438,7 +439,14 @@ def default_rule_pack(cost_model=None, serving: Optional[dict] = None,
       gap is tuning signal, not an outage) and
       `device_memory_bytes{kind="live"}` above 90% of the JX008
       residency budget (error; only on backends that report HBM).
-    * always: any OOM reaching the forensics path is an error.
+    * always: any OOM reaching the forensics path is an error, and the
+      sentinel's `train_grad_norm` gauge growing faster than
+      `grad_norm_rate`/s is a WARNING — the divergence *precursor*: the
+      run ledger records the gradient starting to climb before a loss
+      ever goes non-finite, so a post-mortem (`cli slo --ledger`) shows
+      when the run began to destabilize, not just when it died. The
+      absolute rate is model-scale dependent; tune it per workload. The
+      selector matching nothing (no sentinel attached) never alerts.
 
     `for_seconds` debounces to ~2 ledger samples so a single noisy
     window cannot flip a verdict."""
@@ -451,6 +459,14 @@ def default_rule_pack(cost_model=None, serving: Optional[dict] = None,
         severity=ERROR,
         component="device",
         for_seconds=0.0,
+    ), SLORule(
+        name="grad_norm_divergence_precursor",
+        kind="rate_of_change",
+        series="train_grad_norm",
+        op=">", value=float(grad_norm_rate),
+        severity=WARNING,
+        component="fit",
+        for_seconds=debounce,
     )]
     if serving:
         component = serving.get("component", "serving")

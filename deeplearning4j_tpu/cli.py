@@ -839,21 +839,37 @@ def cmd_blackbox(args) -> int:
 def cmd_resume(args) -> int:
     """Operator half of the resume contract (train/checkpoint): describe
     the newest checkpoint in a directory — iteration/epoch/reason/age and
-    the mid-epoch TrainState it carries — and prove the zip actually
-    loads. Exit 0 when a loadable checkpoint exists, 1 when the directory
-    is empty or every checkpoint is torn/unreadable: scriptable as a
-    pre-flight gate before `fit(resume_from=...)` (or as the init
-    container of a preemptible training pod)."""
+    the mid-epoch TrainState it carries — verify its per-entry SHA-256
+    digest manifest, and prove the zip actually loads. Exit 0 when a
+    loadable, integrity-clean checkpoint exists; 1 when the directory is
+    empty, every checkpoint is torn/unreadable, or the newest one fails
+    digest verification (the per-entry status is printed so the operator
+    sees WHICH entry rotted): scriptable as a pre-flight gate before
+    `fit(resume_from=...)` (or as the init container of a preemptible
+    training pod). Pre-digest legacy checkpoints carry no manifest and
+    pass with a note — nothing to verify against; `--no-validate` stays
+    metadata-only (digest verification reads the payload, so it is
+    skipped there too)."""
     import json as _json
 
     from deeplearning4j_tpu.train.checkpoint import describe_latest
+    from deeplearning4j_tpu.utils.model_serializer import verify_checkpoint
 
     info = describe_latest(args.directory)
     if info is None:
         print(f"resume: no checkpoint in {args.directory!r} "
               "(empty directory = fresh start)", file=sys.stderr)
         return 1
+    rc = 0
+    integrity = None
     if not args.no_validate:
+        # digest verification reads the payload, so it respects the
+        # --no-validate "metadata only" contract
+        integrity = verify_checkpoint(info["path"])
+        info["integrity"] = integrity
+        if not integrity["ok"]:
+            rc = 1
+    if not args.no_validate and integrity["ok"]:
         # the describe is metadata-level; this proves the full payload
         # (config, params, layer/updater state) deserializes
         from deeplearning4j_tpu.utils.model_serializer import load_model
@@ -868,13 +884,37 @@ def cmd_resume(args) -> int:
         info["num_params"] = int(model.num_params())
     if args.json:
         print(_json.dumps(info, indent=2, default=str))
-        return 0
+        return rc
     age = info.get("age_seconds")
     print(f"checkpoint: {info['path']}")
     print(f"  iteration: {info.get('iteration')}  "
           f"epoch: {info.get('epoch')}  reason: {info.get('reason')}")
     if age is not None:
         print(f"  age: {age:.1f}s")
+    if integrity is None:
+        pass  # --no-validate: metadata only, payload never opened
+    elif integrity.get("legacy"):
+        print("  integrity: no digest manifest (pre-digest checkpoint) "
+              "— nothing to verify against")
+    elif integrity.get("error"):
+        print(f"  integrity: FAILED — {integrity['error']}")
+    else:
+        n_ok = sum(1 for e in integrity["entries"].values()
+                   if e["status"] == "ok")
+        verdict = ("ok" if integrity["ok"]
+                   else "FAILED — restore would fall back to the "
+                        "previous good checkpoint")
+        print(f"  integrity: {verdict} ({n_ok}/"
+              f"{len(integrity['entries'])} entries, sha256)")
+        for name, e in sorted(integrity["entries"].items()):
+            status = e["status"]
+            extra = ""
+            if status == "mismatch":
+                extra = (f"  (expected {e.get('expected')}…, got "
+                         f"{e.get('got')}…)")
+            elif status == "unreadable":
+                extra = f"  ({e.get('error')})"
+            print(f"    {status:<10} {name}{extra}")
     if info.get("network_type"):
         print(f"  model: {info['network_type']} "
               f"({info.get('num_params')} params)  validated: loads OK")
@@ -886,7 +926,7 @@ def cmd_resume(args) -> int:
                  else ""))
     else:
         print("  mid-epoch state: none (resume restarts its epoch)")
-    return 0
+    return rc
 
 
 def cmd_doctor(args) -> int:
@@ -1165,7 +1205,7 @@ def _chaos_training(plan, steps: int) -> dict:
     }
 
 
-def _chaos_default_plan(preset: str, seed: int):
+def _chaos_default_plan(preset: str, seed: int, steps: int = 24):
     from deeplearning4j_tpu.utils import faultpoints as fp
 
     if preset == "serving":
@@ -1176,10 +1216,103 @@ def _chaos_default_plan(preset: str, seed: int):
                 .add("replica_forward", "error", p=0.08)
                 .add("replica_forward", "latency", p=0.2,
                      latency_ms=10.0))
+    if preset == "divergence":
+        # seeded NaN at step k (mid-run, past the first checkpoint) —
+        # the deterministic rehearsal of detect -> quarantine ->
+        # rollback -> recover; the sentinel must bring the fit home
+        # with a finite final loss or the run exits 1
+        k = max(2, steps // 2)
+        return (fp.FaultPlan(seed=seed)
+                .add("train_step", "nan", between=(k, k)))
     return (fp.FaultPlan(seed=seed)
             .add("etl_worker", "latency", p=0.2, latency_ms=10.0)
             .add("ckpt_write", "error", every_nth=2, max_fires=1)
             .add("device_put", "latency", p=0.1, latency_ms=5.0))
+
+
+def _chaos_divergence(plan, steps: int) -> dict:
+    """Divergence preset: a deterministic fit with checkpointing and
+    the divergence sentinel armed, under a seeded NaN-at-step-k plan
+    (the `nan` fault kind taints the batch through the REAL dispatch).
+    The resilience loop under test: the sentinel must catch the
+    non-finite loss, quarantine the batch, roll back to the last-good
+    checkpoint, replay past it, and finish with a FINITE final loss —
+    anything else (a raise, a wedge, a NaN final score) is a violated
+    verdict."""
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.data.iterators import ListDataSetIterator
+    from deeplearning4j_tpu.train.checkpoint import CheckpointListener
+    from deeplearning4j_tpu.train.sentinel import (
+        DivergenceSentinel,
+        TrainingDivergedError,
+    )
+    from deeplearning4j_tpu.utils import faultpoints as fp
+
+    n_in = 8
+    net = _chaos_net(n_in)
+    rng = np.random.default_rng(0)
+    full = DataSet(
+        rng.standard_normal((8 * steps, n_in)).astype(np.float32),
+        np.eye(3, dtype=np.float32)[rng.integers(0, 3, 8 * steps)])
+    ckdir = tempfile.mkdtemp(prefix="dl4j-chaos-ckpt-")
+    listener = CheckpointListener(
+        ckdir, every_n_iterations=max(2, steps // 6),
+        every_n_epochs=None, keep_last=4, async_save=False)
+    sentinel = DivergenceSentinel(rollback_after=1, max_rollbacks=2)
+    net.set_listeners(listener)
+    net.set_sentinel(sentinel)
+    result = {}
+
+    def run():
+        try:
+            net.fit(ListDataSetIterator(full, 8), epochs=1,
+                    async_prefetch=False)
+            final = float(np.asarray(net._score))
+            result["final_score"] = final
+            result["final_score_finite"] = bool(np.isfinite(final))
+            result["outcome"] = ("recovered" if result["final_score_finite"]
+                                 else "diverged")
+        except TrainingDivergedError as e:
+            result["outcome"] = "diverged"
+            result["failure"] = f"TrainingDivergedError: {e}"
+            result["dump_path"] = e.dump_path
+        except Exception as e:
+            result["outcome"] = "diverged"
+            result["failure"] = f"{type(e).__name__}: {e}"
+
+    with fp.active(plan):
+        t = threading.Thread(target=run, daemon=True,
+                             name="dl4j-chaos-cli-fit")
+        t.start()
+        t.join(timeout=_chaos_budget(plan))
+        wedged = t.is_alive()
+    if wedged:
+        result["outcome"] = "wedged"
+    return {
+        "workload": {"steps": steps, "checkpoint_dir": ckdir},
+        "sentinel": {
+            "anomalies": sentinel.anomalies,
+            "quarantined": sentinel.quarantined,
+            "rollbacks": sentinel.rollbacks,
+            "quarantine_records": list(sentinel.records),
+            "findings": [f.to_dict() for f in sentinel.findings],
+        },
+        "conservation_ok": True,  # no serving books in this preset
+        "final_score_finite": result.get("final_score_finite", False),
+        # the gate must not be vacuous: a finite final loss only counts
+        # when the injected divergence actually reached the sentinel —
+        # a broken injection chain must fail the rehearsal, not pass it
+        "loop_exercised": (sentinel.anomalies >= 1
+                           and sentinel.quarantined >= 1),
+        "wedged_threads": (["dl4j-chaos-cli-fit"] if wedged else []),
+        "unhealthy_components": _chaos_unhealthy(),
+        **result,
+    }
 
 
 def _chaos_trace_report(preset: str, path: str) -> dict:
@@ -1227,7 +1360,8 @@ def cmd_chaos(args) -> int:
         if args.seed is not None:
             plan.seed = int(args.seed)
     else:
-        plan = _chaos_default_plan(args.preset, args.seed or 0)
+        plan = _chaos_default_plan(args.preset, args.seed or 0,
+                                   steps=args.steps)
     trace_out = args.trace_out
     if trace_out:
         prev_tracing = _tracing.is_enabled()
@@ -1237,6 +1371,8 @@ def cmd_chaos(args) -> int:
         if args.preset == "serving":
             report = _chaos_serving(plan, args.requests, args.clients,
                                     args.deadline_ms)
+        elif args.preset == "divergence":
+            report = _chaos_divergence(plan, args.steps)
         else:
             report = _chaos_training(plan, args.steps)
     finally:
@@ -1254,6 +1390,7 @@ def cmd_chaos(args) -> int:
     ok = (report["outcome"] in ("recovered", "cleanly_failed")
           and report["conservation_ok"]
           and not report["unhealthy_components"]
+          and report.get("loop_exercised", True)
           and report.get("trace", {}).get("fault_trace_ok", True))
     report["verdict"] = "ok" if ok else "violated"
     if args.json == "-":
@@ -1276,6 +1413,14 @@ def cmd_chaos(args) -> int:
         if "metrics" in report:
             print(f"  books: {report['metrics']} "
                   f"(conserved: {report['conservation_ok']})")
+        if "sentinel" in report:
+            s = report["sentinel"]
+            print(f"  sentinel: {s['anomalies']} anomaly(ies), "
+                  f"{s['quarantined']} quarantined, "
+                  f"{s['rollbacks']} rollback(s)"
+                  + (f", final loss {report.get('final_score'):.6g} "
+                     f"(finite: {report['final_score_finite']})"
+                     if report.get("final_score") is not None else ""))
         if report.get("failure"):
             print(f"  failure: {report['failure']}")
         if report.get("trace"):
@@ -1533,7 +1678,8 @@ def main(argv=None) -> int:
     rs.add_argument("--json", action="store_true",
                     help="machine-readable output")
     rs.add_argument("--no-validate", action="store_true",
-                    help="skip the full model load (metadata only)")
+                    help="skip the digest verification and full model "
+                         "load (metadata only)")
     rs.set_defaults(fn=cmd_resume)
 
     d = sub.add_parser(
@@ -1570,8 +1716,11 @@ def main(argv=None) -> int:
              "(utils/faultpoints; exit 1 on wedge/conservation "
              "violation)")
     ch.add_argument("--preset", required=True,
-                    choices=("serving", "training"),
-                    help="workload to run under the plan")
+                    choices=("serving", "training", "divergence"),
+                    help="workload to run under the plan (divergence: "
+                         "seeded NaN-at-step-k fit with the sentinel "
+                         "armed — exit 1 unless quarantine/rollback "
+                         "recover a finite final loss)")
     ch.add_argument("--plan", default=None, metavar="JSON",
                     help="FaultPlan JSON file (default: a built-in plan "
                          "for the preset)")

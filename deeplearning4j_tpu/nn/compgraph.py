@@ -335,8 +335,10 @@ class ComputationGraph(NetworkBase):
         """Unjitted optimizer-step body around a loss builder
         (p, states, data, rng) -> (score, new_states) — same tail as
         MultiLayerNetwork's: gradient masking/normalization, per-leaf lr,
-        updater, param update. Shared by the single-step, truncated,
-        fused-TBPTT and multi-batch programs."""
+        updater, param update, plus the in-graph `[loss, grad_norm]`
+        divergence diagnostic returned next to the score (see the MLN
+        docstring). Shared by the single-step, truncated, fused-TBPTT
+        and multi-batch programs."""
         if loss_builder is None:
             loss_builder = self._std_loss_builder()
         gnorm = self.net_conf.gradient_normalization
@@ -359,6 +361,12 @@ class ComputationGraph(NetworkBase):
             )(params)
             if gshard is not None:
                 grads = jax.lax.with_sharding_constraint(grads, gshard)
+            # global grad norm of the RAW gradient (before masking/
+            # clipping), accumulated in f32 — the sentinel diagnostic
+            gsq = jnp.float32(0.0)
+            for g in jax.tree_util.tree_leaves(grads):
+                gsq = gsq + jnp.sum(jnp.square(g.astype(jnp.float32)))
+            diag = jnp.stack([score.astype(jnp.float32), jnp.sqrt(gsq)])
             if not minimize:
                 grads = jax.tree_util.tree_map(lambda g: -g, grads)
             grads = [
@@ -380,8 +388,8 @@ class ComputationGraph(NetworkBase):
                 ]
                 stats = {"grad_mm": mm(grads), "update_mm": mm(updates),
                          "param_mm": mm(new_params)}
-                return new_params, merged, new_upd, score, stats
-            return new_params, merged, new_upd, score
+                return new_params, merged, new_upd, score, diag, stats
+            return new_params, merged, new_upd, score, diag
 
         return step
 
@@ -413,7 +421,8 @@ class ComputationGraph(NetworkBase):
             rng,
         )
         params, states, upd, score = out[:4]
-        self._last_stats = out[4] if len(out) > 4 else None
+        self._step_diag = out[4]
+        self._last_stats = out[5] if len(out) > 5 else None
         self.params_list = params
         self.upd_state = upd
         self._score = score
@@ -510,13 +519,14 @@ class ComputationGraph(NetworkBase):
         lrs = jnp.asarray(
             [schedule_lr(self.net_conf, self.iteration + i)
              for i in range(K)], jnp.float32)
-        params, states, upd, last = fn(
+        params, states, upd, last, diag = fn(
             self.params_list, self.state_list, self.upd_state,
             xs, ys, fms, lms, lrs, jnp.asarray(self.iteration, jnp.uint32))
         self.params_list = params
         self.upd_state = upd
         self.state_list = states
         self._score = last
+        self._step_diag = diag
         self._last_stats = None
         self.iteration += K
 
@@ -535,14 +545,16 @@ class ComputationGraph(NetworkBase):
                 p, st, us = carry
                 xs_i, ys_i, fms_i, lms_i, lr, i = inp
                 rng, t = self._step_rng_and_t(key, t0, i)
-                p, st, us, sc = body(p, st, us,
-                                     (xs_i, ys_i, fms_i, lms_i), lr, t, rng)
-                return (p, st, us), sc
+                p, st, us, sc, dg = body(p, st, us,
+                                         (xs_i, ys_i, fms_i, lms_i),
+                                         lr, t, rng)
+                return (p, st, us), (sc, dg)
 
-            (params, states, upd_state), scores = jax.lax.scan(
+            (params, states, upd_state), (scores, diags) = jax.lax.scan(
                 scan_body, (params, states, upd_state),
                 (xs, ys, fms, lms, lrs, jnp.arange(K, dtype=jnp.uint32)))
-            return params, states, upd_state, scores[-1]
+            diag = jnp.stack([diags[-1, 0], jnp.max(diags[:, 1])])
+            return params, states, upd_state, scores[-1], diag
 
         # stacked batches: [K, B, ...] — batch dim 1 shards over "data"
         return self._jit_step(step, data_argnums=(3, 4, 5, 6),
@@ -684,20 +696,22 @@ class ComputationGraph(NetworkBase):
 
             # segment 0 inline: its merged states establish the carry
             # pytree (zero-state {} -> populated h/c) for the scan
-            params, states, upd_state, s0 = run_seg(
+            params, states, upd_state, s0, d0 = run_seg(
                 params, states, upd_state, 0)
             if n_seg == 1:
-                return params, states, upd_state, s0
+                return params, states, upd_state, s0, d0
 
             def scan_body(carry, i):
                 p, st, us = carry
-                p, st, us, score = run_seg(p, st, us, i)
-                return (p, st, us), score
+                p, st, us, score, dg = run_seg(p, st, us, i)
+                return (p, st, us), (score, dg)
 
-            (params, states, upd_state), scores = jax.lax.scan(
+            (params, states, upd_state), (scores, diags) = jax.lax.scan(
                 scan_body, (params, states, upd_state),
                 jnp.arange(1, n_seg))
-            return params, states, upd_state, scores[-1]
+            diag = jnp.stack([diags[-1, 0],
+                              jnp.maximum(d0[1], jnp.max(diags[:, 1]))])
+            return params, states, upd_state, scores[-1], diag
 
         return self._jit_step(step)
 
@@ -716,12 +730,13 @@ class ComputationGraph(NetworkBase):
         data = ([jnp.asarray(x) for x in mds.features],
                 [jnp.asarray(y) for y in mds.labels],
                 self._jas(mds.features_masks), self._jas(mds.labels_masks))
-        params, states, upd, last = step_fn(
+        params, states, upd, last, diag = step_fn(
             self.params_list, states, self.upd_state, data, lrs,
             jnp.asarray(self.iteration, jnp.uint32), None)
         self.params_list = params
         self.upd_state = upd
         self._score = last
+        self._step_diag = diag
         self._last_stats = None
         self.iteration += n_seg
         # persist only non-RNN state (running stats); RNN carry is per-batch
@@ -756,7 +771,8 @@ class ComputationGraph(NetworkBase):
             rng,
         )
         params, states, upd, score = out[:4]
-        self._last_stats = out[4] if len(out) > 4 else None
+        self._step_diag = out[4]
+        self._last_stats = out[5] if len(out) > 5 else None
         self.params_list = params
         self.upd_state = upd
         self._score = score

@@ -285,7 +285,14 @@ class MultiLayerNetwork(NetworkBase):
         """Unjitted optimizer-step body around a loss builder
         (p, states, data, rng) -> (score, new_states). The tail — gradient
         masking/normalization, per-leaf lr, updater, param update — is
-        shared by the standard, truncated-backward and fused-TBPTT steps."""
+        shared by the standard, truncated-backward and fused-TBPTT steps.
+
+        Returns (params, states, upd_state, score, diag[, stats]): `diag`
+        is the in-graph divergence diagnostic `[loss, global grad norm]`
+        — a 2-vector fused into the same program (a few elementwise
+        reductions next to a full backward pass), so the sentinel's
+        per-step judgment costs ONE device read that rides the score
+        fetch instead of a second sync."""
         gnorm = self.net_conf.gradient_normalization
         gthresh = self.net_conf.gradient_normalization_threshold
         mults = self._lr_mult_tree()
@@ -309,6 +316,13 @@ class MultiLayerNetwork(NetworkBase):
             )(params)
             if gshard is not None:
                 grads = jax.lax.with_sharding_constraint(grads, gshard)
+            # global grad norm of the RAW gradient (before masking/
+            # clipping — clipping would hide exactly the explosion the
+            # sentinel watches for), accumulated in f32
+            gsq = jnp.float32(0.0)
+            for g in jax.tree_util.tree_leaves(grads):
+                gsq = gsq + jnp.sum(jnp.square(g.astype(jnp.float32)))
+            diag = jnp.stack([score.astype(jnp.float32), jnp.sqrt(gsq)])
             if not minimize:
                 grads = jax.tree_util.tree_map(lambda g: -g, grads)
             grads = [
@@ -331,8 +345,8 @@ class MultiLayerNetwork(NetworkBase):
                 ]
                 stats = {"grad_mm": mm(grads), "update_mm": mm(updates),
                          "param_mm": mm(new_params)}
-                return new_params, merged, new_upd, score, stats
-            return new_params, merged, new_upd, score
+                return new_params, merged, new_upd, score, diag, stats
+            return new_params, merged, new_upd, score, diag
 
         return step
 
@@ -457,24 +471,29 @@ class MultiLayerNetwork(NetworkBase):
 
             # segment 0 inline: its merged states establish the carry
             # pytree (zero-state {} -> populated h/c) for the scan
-            params, states, upd_state, s0 = run_seg(
+            params, states, upd_state, s0, d0 = run_seg(
                 params, states, upd_state, 0)
             if n_seg == 1:
-                return params, states, upd_state, s0[None], s0
+                return params, states, upd_state, s0[None], s0, d0
 
             def scan_body(carry, i):
                 p, st, us = carry
-                p, st, us, score = run_seg(p, st, us, i)
-                return (p, st, us), score
+                p, st, us, score, dg = run_seg(p, st, us, i)
+                return (p, st, us), (score, dg)
 
-            (params, states, upd_state), scores = jax.lax.scan(
+            (params, states, upd_state), (scores, diags) = jax.lax.scan(
                 scan_body, (params, states, upd_state),
                 jnp.arange(1, n_seg))
             # the final score returned separately so the host can keep a
             # scalar _score without an extra device-indexing dispatch
             last = scores[-1]
+            # whole-batch diagnostic: final score, worst grad norm of
+            # any segment (a NaN segment poisons later params, so the
+            # final loss carries the non-finite signal regardless)
+            diag = jnp.stack([diags[-1, 0],
+                              jnp.maximum(d0[1], jnp.max(diags[:, 1]))])
             scores = jnp.concatenate([s0[None], scores])
-            return params, states, upd_state, scores, last
+            return params, states, upd_state, scores, last, diag
 
         return self._jit_step(step)
 
@@ -491,7 +510,8 @@ class MultiLayerNetwork(NetworkBase):
             rng,
         )
         params, states, upd, score = out[:4]
-        self._last_stats = out[4] if len(out) > 4 else None
+        self._step_diag = out[4]
+        self._last_stats = out[5] if len(out) > 5 else None
         self.params_list = params
         self.upd_state = upd
         self._score = score
@@ -706,6 +726,9 @@ class MultiLayerNetwork(NetworkBase):
         new_flat, f_new = self._solver.optimize(problem, flat, step0)
         self.params_list = flat_to_params(self.layer_confs, self.params_list, new_flat)
         self._score = jnp.asarray(f_new)
+        # no in-graph diagnostic on the line-search path: the sentinel
+        # degrades to the finite check on the score alone
+        self._step_diag = None
         self.iteration += 1
         self._notify(getattr(ds, "reported_examples", None)
                          or ds.num_examples(), ds)
@@ -796,13 +819,14 @@ class MultiLayerNetwork(NetworkBase):
             for a in (ds.features, ds.labels, ds.features_mask,
                       ds.labels_mask)
         )
-        params, states, upd, _scores, last = step_fn(
+        params, states, upd, _scores, last, diag = step_fn(
             self.params_list, states, self.upd_state, data, lrs,
             jnp.asarray(self.iteration, jnp.uint32), None,
         )
         self.params_list = params
         self.upd_state = upd
         self._score = last
+        self._step_diag = diag
         self._last_stats = None
         self.iteration += n_seg
         # persist only non-RNN state (running stats); RNN carry is per-batch
@@ -866,13 +890,14 @@ class MultiLayerNetwork(NetworkBase):
                 p, st, us = carry
                 data_i, lr, i = inp
                 rng, t = self._step_rng_and_t(key, t0, i)
-                p, st, us, sc = body(p, st, us, data_i, lr, t, rng)
-                return (p, st, us), sc
+                p, st, us, sc, dg = body(p, st, us, data_i, lr, t, rng)
+                return (p, st, us), (sc, dg)
 
-            (params, states, upd_state), scores = jax.lax.scan(
+            (params, states, upd_state), (scores, diags) = jax.lax.scan(
                 scan_body, (params, states, upd_state),
                 (data_stack, lrs, jnp.arange(K, dtype=jnp.uint32)))
-            return params, states, upd_state, scores[-1]
+            diag = jnp.stack([diags[-1, 0], jnp.max(diags[:, 1])])
+            return params, states, upd_state, scores[-1], diag
 
         # stacked batches: [K, B, ...] — under a mesh plan the batch dim
         # (1, not 0) shards over the data axis
@@ -888,13 +913,14 @@ class MultiLayerNetwork(NetworkBase):
         lrs = jnp.asarray(
             [schedule_lr(self.net_conf, self.iteration + i)
              for i in range(K)], jnp.float32)
-        params, states, upd, last = fn(
+        params, states, upd, last, diag = fn(
             self.params_list, self.state_list, self.upd_state, data, lrs,
             jnp.asarray(self.iteration, jnp.uint32))
         self.params_list = params
         self.upd_state = upd
         self.state_list = states
         self._score = last
+        self._step_diag = diag
         self._last_stats = None
         self.iteration += K
 
@@ -936,17 +962,19 @@ class MultiLayerNetwork(NetworkBase):
 
             # batch 0 / segment 0 inline: bootstraps the carry structure
             data0 = pick(0)
-            params, states, upd_state, _ = run_seg(
+            params, states, upd_state, _, d00 = run_seg(
                 params, states, upd_state, data0, 0, 0)
+            gmax = d00[1]
             if n_seg > 1:
                 def seg_scan0(carry, i):
                     p, st, us = carry
-                    p, st, us, sc = run_seg(p, st, us, data0, i, i)
-                    return (p, st, us), sc
+                    p, st, us, sc, dg = run_seg(p, st, us, data0, i, i)
+                    return (p, st, us), dg
 
-                (params, states, upd_state), _ = jax.lax.scan(
+                (params, states, upd_state), dgs0 = jax.lax.scan(
                     seg_scan0, (params, states, upd_state),
                     jnp.arange(1, n_seg))
+                gmax = jnp.maximum(gmax, jnp.max(dgs0[:, 1]))
 
             def batch_body(carry, b):
                 p, st, us = carry
@@ -955,18 +983,20 @@ class MultiLayerNetwork(NetworkBase):
 
                 def seg_scan(c2, s):
                     p2, st2, us2 = c2
-                    p2, st2, us2, sc = run_seg(
+                    p2, st2, us2, sc, dg = run_seg(
                         p2, st2, us2, data_b, s, b * n_seg + s)
-                    return (p2, st2, us2), sc
+                    return (p2, st2, us2), (sc, dg)
 
-                (p, st, us), scs = jax.lax.scan(
+                (p, st, us), (scs, dgs) = jax.lax.scan(
                     seg_scan, (p, st, us), jnp.arange(n_seg))
-                return (p, st, us), scs[-1]
+                return (p, st, us), (scs[-1], jnp.max(dgs[:, 1]))
 
-            (params, states, upd_state), lasts = jax.lax.scan(
+            (params, states, upd_state), (lasts, gmaxes) = jax.lax.scan(
                 batch_body, (params, states, upd_state),
                 jnp.arange(1, K))
-            return params, states, upd_state, lasts[-1]
+            diag = jnp.stack([lasts[-1],
+                              jnp.maximum(gmax, jnp.max(gmaxes))])
+            return params, states, upd_state, lasts[-1], diag
 
         return self._jit_step(step, stacked_data=True)
 
@@ -989,12 +1019,13 @@ class MultiLayerNetwork(NetworkBase):
         lrs = jnp.asarray(
             [schedule_lr(self.net_conf, self.iteration + j)
              for j in range(K * n_seg)], jnp.float32)
-        params, states, upd, last = fn(
+        params, states, upd, last, diag = fn(
             self.params_list, states, self.upd_state, data, lrs,
             jnp.asarray(self.iteration, jnp.uint32), None)
         self.params_list = params
         self.upd_state = upd
         self._score = last
+        self._step_diag = diag
         self._last_stats = None
         self.iteration += K * n_seg
         self.state_list = [

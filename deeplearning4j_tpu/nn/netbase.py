@@ -32,6 +32,7 @@ from deeplearning4j_tpu.utils import health as _health
 from deeplearning4j_tpu.utils import metrics as _metrics
 from deeplearning4j_tpu.utils import runledger as _runledger
 from deeplearning4j_tpu.utils import tracing as _tracing
+from deeplearning4j_tpu.train import sentinel as _sentinel
 
 logger = logging.getLogger("deeplearning4j_tpu")
 
@@ -102,6 +103,13 @@ class NetworkBase:
         # StepHangError into the fit thread (read when enriching the
         # async-raised bare exception)
         self._hang_dump_path = None
+        # the attached train/sentinel.DivergenceSentinel (set_sentinel).
+        # None = the fit loop pays one attribute read per dispatch
+        self._sentinel = None
+        # in-graph step diagnostic: a [loss, grad_norm] 2-vector every
+        # step body returns next to the score — ONE device transfer
+        # resolves both for the sentinel's per-step judgment
+        self._step_diag = None
 
     # -- to be provided by subclasses ----------------------------------------
 
@@ -364,6 +372,19 @@ class NetworkBase:
                 self._trunc_step_fn = None
         return self
 
+    def set_sentinel(self, sentinel):
+        """Attach a train/sentinel.DivergenceSentinel: every optimizer
+        step is judged against the in-graph (loss, grad-norm) diagnostic;
+        anomalous steps are discarded (quarantine), persistent anomalies
+        restore the last-good checkpoint (rollback) and bounded failures
+        raise TrainingDivergedError. Pass None to detach. A judged step
+        blocks on its own diagnostic, so the sentinel trades the async
+        dispatch pipeline's lookahead for per-step safety — attach it to
+        runs that must survive numerical failure, not to microbenchmarks.
+        Disables step fusion (each step must be judged individually)."""
+        self._sentinel = sentinel
+        return self
+
     def set_fused_steps(self, k: int):
         """Run up to `k` consecutive same-shape minibatches as ONE jitted
         dispatch (a `lax.scan` over the stacked batches — same math, same
@@ -497,13 +518,15 @@ class NetworkBase:
         return ins
 
     def _timed_fit(self, fit_fn, data_wait: float, n_examples: int,
-                   n_batches: int = 1):
+                   n_batches: int = 1, batches=None):
         """Run one dispatch (a single `_fit_dataset` or a fused flush)
         under the step-phase timers: data-wait / dispatch / device-sync,
         each a histogram in the shared registry and a span when tracing
         is on. Device-sync is only MEASURED (a blocking read of the
         step's score) when tracing is enabled — observability must not
-        change the async dispatch pipeline it observes."""
+        change the async dispatch pipeline it observes. `batches` names
+        the DataSet(s) behind this dispatch for the divergence sentinel's
+        quarantine records and the `nan` fault kind's batch taint."""
         ins = self._fit_obs()
         it0 = self.iteration
         sync = None
@@ -519,13 +542,22 @@ class NetworkBase:
         hb0 = self._fit_heartbeat
         if hb0 is not None:
             hb0.beat()
+        # sentinel pre-capture: one attribute read with no sentinel
+        # attached (the <10us off-path contract); with one, the pre-step
+        # references that make an anomalous step's update discardable
+        pre = _sentinel.pre_step(self)
         t0 = time.perf_counter()
         with _tracing.span("fit/step", data_wait_ms=round(data_wait * 1e3, 3)):
             with _tracing.span("fit/dispatch"):
                 # chaos hook: an `oom` fault here is a device allocator
                 # failure mid-fit — it unwinds through _run_fit's OOM
-                # forensics exactly as a real RESOURCE_EXHAUSTED would
-                _faults.fault_point("train_step")
+                # forensics exactly as a real RESOURCE_EXHAUSTED would;
+                # a `nan` fault taints this batch's features so the
+                # divergence makes it into the REAL dispatch (NaN loss,
+                # NaN grads — exactly what the sentinel exists to catch)
+                injected = _faults.fault_point("train_step")
+                if injected == "nan" and batches:
+                    _faults.taint_nan(batches[0])
                 fit_fn()
             dispatch = time.perf_counter() - t0
             if _tracing.is_enabled() and self._score is not None:
@@ -561,6 +593,12 @@ class NetworkBase:
         # attached (the off-by-default overhead contract); sampling
         # itself lives on the ledger's own daemon, never here
         _runledger.note_fit_step(self)
+        # sentinel judgment AFTER the step's own forensics recorded it:
+        # an anomalous step stays visible in the flight recorder even
+        # though its update is about to be discarded. May raise
+        # RollbackSignal (answered by _run_fit) or TrainingDivergedError.
+        if pre is not None:
+            _sentinel.post_step(self, pre, batches)
         hb = self._fit_heartbeat
         if hb is not None:
             hb.beat()
@@ -608,7 +646,7 @@ class NetworkBase:
             # restore BEFORE staging: the iterator state lands on the
             # caller's iterator, not the pipeline wrappers about to be
             # composed around it
-            skip_batches, epochs = self._restore_for_resume(
+            skip_batches, epochs, _ = self._restore_for_resume(
                 resume_from, iterator, epochs)
             if self._mesh_plan is not None:
                 # checkpoint arrays arrive as host numpy: re-commit them
@@ -623,17 +661,29 @@ class NetworkBase:
         # hooks must see their own batch) — EXCEPT the mesh plan's own
         # shard_batch: sharded batches stack fine, and the stacked fused
         # programs shard batch dim 1 (stacked_data in _jit_step), so
-        # mesh-attached nets keep their dispatch-fusion opt-in
+        # mesh-attached nets keep their dispatch-fusion opt-in. The
+        # divergence sentinel also disables fusion: quarantine must be
+        # able to discard ONE step's update, not a fused group's.
         plan_shard = (None if self._mesh_plan is None
                       else self._mesh_plan.shard_batch)
         fuse_k = self._fused_k if (
             self._fused_k > 1
             and not self.listeners
             and not self._collect_stats
+            and self._sentinel is None
             and (self._batch_transform is None
                  or self._batch_transform == plan_shard)
             and self._fused_fit_supported()
         ) else 1
+        # sentinel wiring: resolve the rollback directory (explicit >
+        # resume_from > an attached CheckpointListener) and reset the
+        # per-fit escalation counters
+        if self._sentinel is not None:
+            self._sentinel.bind(self, resume_dir=resume_from)
+        # the epoch target the rollback loop restores toward: `epochs`
+        # is already "remaining" here (the initial resume consumed the
+        # completed ones), so the absolute target is epoch + remaining
+        total_epoch_target = int(self.epoch) + int(epochs)
         # liveness: the fit thread holds a busy slot on the "fit"
         # heartbeat for the whole run and beats once per dispatch
         # (_timed_fit). With hang_timeout the watchdog's stall action
@@ -646,7 +696,18 @@ class NetworkBase:
         self._fit_heartbeat = hb
         try:
             with hb.busy():
-                self._fit_epochs(iterator, epochs, fuse_k, skip_batches)
+                while True:
+                    try:
+                        self._fit_epochs(iterator, epochs, fuse_k,
+                                         skip_batches)
+                        break
+                    except _sentinel.RollbackSignal:
+                        # the sentinel's escalation: restore the last-
+                        # good checkpoint and replay — bounded attempts
+                        # (note_rollback raises TrainingDivergedError
+                        # past the budget)
+                        skip_batches, epochs = self._rollback_restore(
+                            iterator, total_epoch_target)
         except _health.StepHangError as e:
             if e.dump_path is not None:
                 raise  # already carries its forensics
@@ -804,25 +865,83 @@ class NetworkBase:
         return None if ts is None else dict(ts)
 
     def _restore_for_resume(self, directory: str, iterator,
-                            epochs: int):
-        """Load the newest checkpoint in `directory` into this net and
-        prime the mid-epoch replay: restores the iterator's epoch-start
-        state and returns (batches to skip in the first epoch, epochs
-        remaining out of the requested total). An empty/missing
-        directory is a fresh start — the same command line works on
-        first boot and after a preemption."""
-        from deeplearning4j_tpu.train.checkpoint import latest_checkpoint
+                            epochs: int, require_finite: bool = False,
+                            lr_drift_ok: bool = False,
+                            reject_iterations=()):
+        """Load the newest GOOD checkpoint in `directory` into this net
+        and prime the mid-epoch replay: restores the iterator's
+        epoch-start state and returns (batches to skip in the first
+        epoch, epochs remaining out of the requested total, the restored
+        path or None). An empty/missing directory is a fresh start — the
+        same command line works on first boot and after a preemption.
+
+        "Good" is enforced, not assumed: each candidate's per-entry
+        SHA-256 manifest is verified before the load (a bit-flipped or
+        torn zip is skipped — loudly, counted — and the previous
+        checkpoint is used instead), a candidate that fails to
+        deserialize is skipped the same way, and the sentinel's rollback
+        path additionally rejects checkpoints whose restored params
+        carry NaN/Inf (`require_finite`) or whose iteration falls inside
+        a quarantined step (`reject_iterations` — a listener can save
+        DURING the anomalous dispatch, before the sentinel judged it) —
+        "last-good" must actually be good."""
+        from deeplearning4j_tpu.train.checkpoint import (
+            NoUsableCheckpointError,
+            checkpoint_candidates,
+            note_bad_checkpoint,
+            verified_checkpoints,
+        )
         from deeplearning4j_tpu.utils.model_serializer import (
+            ConfigMismatchError,
             restore_fit_state,
         )
 
-        found = latest_checkpoint(directory)
-        if found is None:
+        meta = path = None
+        for cand_path, cand_meta in verified_checkpoints(directory):
+            if reject_iterations and int(
+                    cand_meta.get("iteration", -1)) in reject_iterations:
+                # a save captured DURING a quarantined step holds the
+                # very update the sentinel discarded — finite, digest-
+                # clean, and still not "good"
+                note_bad_checkpoint(
+                    cand_path, "captured from a quarantined step")
+                continue
+            try:
+                meta = restore_fit_state(self, cand_path,
+                                         ignore_lr=lr_drift_ok)
+            except ConfigMismatchError:
+                # a changed architecture is a USER error every candidate
+                # repeats — raise it, don't silently discard the whole
+                # checkpoint history and "start fresh"
+                raise
+            except Exception as e:
+                note_bad_checkpoint(
+                    cand_path, f"restore failed: {type(e).__name__}: {e}")
+                meta = None
+                continue
+            if require_finite and not self._params_finite():
+                note_bad_checkpoint(
+                    cand_path, "restored parameters are non-finite")
+                meta = None
+                continue
+            path = cand_path
+            break
+        if meta is None:
+            if any(True for _ in checkpoint_candidates(directory)):
+                # checkpoints EXIST but every one was rejected: raising
+                # beats silently restarting from iteration 0 (which
+                # would then GC the corrupt zips — progress AND evidence
+                # gone); the rollback path converts this to
+                # TrainingDivergedError
+                raise NoUsableCheckpointError(
+                    f"resume_from={directory!r}: checkpoints exist but "
+                    f"every candidate was rejected (see "
+                    f"checkpoint_integrity_failures_total and the "
+                    f"checkpoint_corrupt events) — not starting fresh "
+                    f"over a corrupted history")
             logger.info("resume_from=%r: no checkpoint found — starting "
                         "fresh", directory)
-            return 0, epochs
-        path, _ = found
-        meta = restore_fit_state(self, path)
+            return 0, epochs, None
         ts = meta.get("train_state") or {}
         skip = int(ts.get("batch_in_epoch", 0))
         it_state = ts.get("iterator_state")
@@ -845,6 +964,62 @@ class NetworkBase:
         _blackbox.get_recorder().record_event(
             "resume", checkpoint=path, iteration=int(self.iteration),
             epoch=int(self.epoch), skip_batches=skip)
+        return skip, remaining, path
+
+    def _params_finite(self) -> bool:
+        """Host check that every parameter leaf is finite — the
+        rollback path's guard against restoring a checkpoint that was
+        saved after the divergence already poisoned the params."""
+        import jax
+
+        for leaf in jax.tree_util.tree_leaves(self.params_list):
+            if not np.all(np.isfinite(np.asarray(leaf))):
+                return False
+        return True
+
+    def _rollback_restore(self, iterator, total_epoch_target: int):
+        """Answer a sentinel RollbackSignal: account the attempt
+        (bounded; optional LR backoff), tear down the abandoned
+        mid-epoch pipeline run, restore the newest checkpoint that
+        verifies AND loads AND is finite, and re-commit it to the mesh.
+        Returns the (skip_batches, epochs_remaining) the replay needs."""
+        sent = self._sentinel
+        directory = sent.note_rollback(self)
+        hb = self._fit_heartbeat
+        if hb is not None:
+            hb.beat()
+        # the RollbackSignal left `for ds in iterator` mid-iteration:
+        # close the run (its worker would keep consuming the base
+        # concurrently with the replay's fresh run) and rewind to the
+        # epoch start — restore_state below overrides the position when
+        # the iterator supports the resume protocol
+        close = getattr(iterator, "close", None)
+        if callable(close):
+            close()
+        iterator.reset()
+        # lr_drift_ok: a previous rollback's lr backoff (or this one's)
+        # must not disqualify checkpoints saved at the original rate
+        from deeplearning4j_tpu.train.checkpoint import (
+            NoUsableCheckpointError,
+        )
+
+        try:
+            skip, remaining, path = self._restore_for_resume(
+                directory, iterator, total_epoch_target,
+                require_finite=True, lr_drift_ok=True,
+                reject_iterations=sent.tainted_iterations)
+        except NoUsableCheckpointError as e:
+            sent.diverged(str(e))
+        if path is None:
+            sent.diverged(
+                f"rollback found no usable checkpoint in {directory!r}")
+        if self._mesh_plan is not None:
+            # checkpoint arrays arrive as host numpy: re-commit to the
+            # mesh so the sharded step's in-shardings stay valid
+            self._mesh_plan.place_net(self)
+        self._step_diag = None
+        if hb is not None:
+            hb.beat()
         return skip, remaining
 
     def _fit_epochs(self, iterator, epochs: int, fuse_k: int,
@@ -890,6 +1065,15 @@ class NetworkBase:
                     self._train_state["batch_in_epoch"] += 1
                     t_etl = time.perf_counter()
                     continue
+                if (self._sentinel is not None
+                        and self._sentinel.should_skip_batch(self, ds)):
+                    # quarantined batch re-encountered (post-rollback
+                    # replay, or the next epoch's pass over bad data):
+                    # consume it without dispatching — re-running it
+                    # would deterministically diverge again
+                    self._train_state["batch_in_epoch"] += 1
+                    t_etl = time.perf_counter()
+                    continue
                 if fuse_k > 1:
                     s = self._ds_signature(ds)
                     if buf and s != sig:
@@ -899,7 +1083,8 @@ class NetworkBase:
                         flushed, n = list(buf), n_buf
                         self._timed_fit(
                             lambda: self._flush_fused(flushed, fuse_k),
-                            wait_accum, n, n_batches=len(flushed))
+                            wait_accum, n, n_batches=len(flushed),
+                            batches=flushed)
                         wait_accum, n_buf = 0.0, 0
                         buf = []
                     wait_accum += wait
@@ -910,19 +1095,22 @@ class NetworkBase:
                         flushed, n = list(buf), n_buf
                         self._timed_fit(
                             lambda: self._flush_fused(flushed, fuse_k),
-                            wait_accum, n, n_batches=len(flushed))
+                            wait_accum, n, n_batches=len(flushed),
+                            batches=flushed)
                         wait_accum, n_buf = 0.0, 0
                         buf = []
                 else:
                     wait_accum += wait
                     self._timed_fit(lambda: self._fit_dataset(ds),
-                                    wait_accum, self._ds_examples(ds))
+                                    wait_accum, self._ds_examples(ds),
+                                    batches=[ds])
                     wait_accum = 0.0
                 t_etl = time.perf_counter()
             if buf:
                 flushed, n = list(buf), n_buf
                 self._timed_fit(lambda: self._flush_fused(flushed, fuse_k),
-                                wait_accum, n, n_batches=len(flushed))
+                                wait_accum, n, n_batches=len(flushed),
+                                batches=flushed)
             if skip > 0:
                 # the resumed epoch ended with replay batches still owed:
                 # the iterator yields fewer batches than the checkpoint's

@@ -70,21 +70,26 @@ def scan_checkpoints(directory: str) -> List[Tuple[int, str]]:
     return sorted(out)
 
 
-def latest_checkpoint(directory: str) -> Optional[Tuple[str, dict]]:
-    """(path, meta) of the newest checkpoint, or None when the directory
-    holds none. Prefers `latest.json`, but a missing, torn (crash
-    mid-write) or dangling metadata file degrades to scanning the
-    checkpoint zips newest-first and reading each zip's own meta — the
-    metadata is an accelerator, never a single point of failure."""
+def checkpoint_candidates(directory: str):
+    """Yield (path, meta) newest-first: the `latest.json` target leads
+    when it exists, then the scanned zips (deduped, each zip's own meta)
+    — the metadata file is an accelerator, never a single point of
+    failure. Zips whose embedded meta cannot be read are still yielded,
+    flagged `"unreadable": True`, so the VERIFIED consumers can reject
+    them loudly instead of silently stepping past corruption."""
+    seen = set()
     meta_path = os.path.join(directory, _LATEST)
     try:
         with open(meta_path) as f:
             meta = json.load(f)
         path = os.path.join(directory, meta["file"])
         if os.path.exists(path):
-            return path, meta
-        logger.warning("checkpoint metadata points at missing %r; "
-                       "falling back to a directory scan", meta["file"])
+            seen.add(meta["file"])
+            yield path, dict(meta)
+        else:
+            logger.warning("checkpoint metadata points at missing %r; "
+                           "falling back to a directory scan",
+                           meta["file"])
     except FileNotFoundError:
         pass
     except (OSError, ValueError, KeyError, json.JSONDecodeError):
@@ -93,22 +98,87 @@ def latest_checkpoint(directory: str) -> Optional[Tuple[str, dict]]:
     import zipfile
 
     for it, name in reversed(scan_checkpoints(directory)):
-        path = os.path.join(directory, name)
-        try:
-            with zipfile.ZipFile(path) as zf:
-                zmeta = json.loads(zf.read("meta.json").decode("utf-8"))
-        except Exception:
-            logger.warning("skipping unreadable checkpoint %r", name)
+        if name in seen:
             continue
+        path = os.path.join(directory, name)
         meta = {
-            "iteration": int(zmeta.get("iteration", it)),
-            "epoch": int(zmeta.get("epoch", 0)),
-            "ts": os.path.getmtime(path),
+            "iteration": it,
+            "epoch": 0,
             "reason": "scan",  # recovered without metadata
             "file": name,
         }
+        try:
+            meta["ts"] = os.path.getmtime(path)
+            with zipfile.ZipFile(path) as zf:
+                zmeta = json.loads(zf.read("meta.json").decode("utf-8"))
+            meta["iteration"] = int(zmeta.get("iteration", it))
+            meta["epoch"] = int(zmeta.get("epoch", 0))
+        except Exception:
+            meta["unreadable"] = True
+        yield path, meta
+
+
+def latest_checkpoint(directory: str) -> Optional[Tuple[str, dict]]:
+    """(path, meta) of the newest READABLE checkpoint, or None when the
+    directory holds none. Metadata-level only (the original PR 7
+    contract — unreadable zips are skipped with a warning); the restore
+    paths use `verified_checkpoints` instead, which additionally checks
+    each candidate's SHA-256 digest manifest."""
+    for path, meta in checkpoint_candidates(directory):
+        if meta.get("unreadable"):
+            logger.warning("skipping unreadable checkpoint %r",
+                           meta.get("file"))
+            continue
         return path, meta
     return None
+
+
+class NoUsableCheckpointError(RuntimeError):
+    """Checkpoints EXISTED in the directory but every one was rejected
+    (digest mismatch, failed load, tainted/non-finite). Deliberately
+    distinct from the empty-directory fresh start: a preemptible pod
+    restarting over a rotted sole checkpoint must stop for an operator,
+    not silently restart from iteration 0 and then GC the evidence."""
+
+
+def note_bad_checkpoint(path: str, why: str) -> None:
+    """Account one rejected checkpoint — loudly: a counter (the
+    fallback is observable, not silent), a flight-recorder event
+    (`cli blackbox` shows the corruption in the incident timeline), and
+    an ERROR log naming the file and the reason."""
+    _metrics.get_registry().counter(
+        "checkpoint_integrity_failures_total",
+        "checkpoints rejected at restore time (digest mismatch, "
+        "unreadable entries, failed load, or non-finite params) — each "
+        "rejection fell back to the previous good checkpoint").labels() \
+        .inc()
+    _blackbox.get_recorder().record_event(
+        "checkpoint_corrupt", checkpoint=str(path), why=str(why)[:300])
+    logger.error("checkpoint %s rejected: %s — falling back to the "
+                 "previous good checkpoint", path, why)
+
+
+def verified_checkpoints(directory: str):
+    """Yield (path, meta) newest-first, SKIPPING — loudly, counted via
+    `note_bad_checkpoint` — every candidate whose per-entry SHA-256
+    manifest fails verification (bit flip, torn entry, missing entry).
+    Pre-digest legacy checkpoints carry no manifest and pass through
+    unverified (`verify_checkpoint` reports them `legacy`), so old
+    checkpoint directories keep restoring."""
+    from deeplearning4j_tpu.utils.model_serializer import verify_checkpoint
+
+    for path, meta in checkpoint_candidates(directory):
+        v = verify_checkpoint(path)
+        if not v["ok"]:
+            bad = [f"{name}:{entry['status']}"
+                   for name, entry in v["entries"].items()
+                   if entry["status"] != "ok"]
+            note_bad_checkpoint(
+                path, "integrity verification failed ("
+                      + (", ".join(bad) or v.get("error", "unknown"))
+                      + ")")
+            continue
+        yield path, meta
 
 
 def describe_latest(directory: str) -> Optional[dict]:
@@ -129,6 +199,37 @@ def describe_latest(directory: str) -> Optional[dict]:
     except Exception:
         out["train_state"] = None
     return out
+
+
+def corrupt_zip_entry(path: str, entry: Optional[str] = None) -> str:
+    """Flip one byte inside a zip entry's stored data — the `corrupt`
+    fault kind's damage, also used directly by the corruption-fallback
+    tests. Targets the largest entry by default (the parameter payload:
+    the flip that would silently train a wrong model if restored
+    unverified). Returns the damaged entry's name."""
+    import zipfile
+
+    with zipfile.ZipFile(path, "r") as zf:
+        infos = [i for i in zf.infolist()
+                 if entry is None or i.filename == entry]
+        if not infos:
+            raise ValueError(f"no such entry {entry!r} in {path}")
+        info = max(infos, key=lambda i: i.compress_size)
+    with open(path, "r+b") as f:
+        # the local file header's name/extra lengths may differ from the
+        # central directory's — read them from the header itself
+        f.seek(info.header_offset + 26)
+        nlen = int.from_bytes(f.read(2), "little")
+        elen = int.from_bytes(f.read(2), "little")
+        data_off = info.header_offset + 30 + nlen + elen
+        pos = data_off + min(8, max(0, info.compress_size - 1))
+        f.seek(pos)
+        b = f.read(1)
+        f.seek(pos)
+        f.write(bytes([b[0] ^ 0x55]))
+    logger.warning("corrupted zip entry %r in %s (injected byte flip)",
+                   info.filename, path)
+    return info.filename
 
 
 class CheckpointListener(IterationListener):
@@ -296,9 +397,14 @@ class CheckpointListener(IterationListener):
                 # BETWEEN write and replace would be the torn-file case
                 # the atomic rename makes survivable (the .tmp is
                 # swept by _gc, latest.json still names the previous
-                # good checkpoint)
-                _faults.fault_point("ckpt_write", reason=reason)
+                # good checkpoint). A `corrupt` fault byte-flips an
+                # entry of the zip that WAS written — the silent
+                # bit-rot case the digest manifest + restore fallback
+                # exist for, made deterministically replayable.
+                injected = _faults.fault_point("ckpt_write", reason=reason)
                 snap.write(tmp)
+                if injected == "corrupt":
+                    corrupt_zip_entry(tmp)
                 os.replace(tmp, path)  # atomic: never a torn checkpoint
             meta = {
                 "iteration": snap.iteration,
@@ -470,19 +576,37 @@ class CheckpointListener(IterationListener):
     @staticmethod
     def restore_latest(directory: str,
                        load_updater: bool = True) -> Tuple[object, dict]:
-        """(model, meta) from the newest checkpoint in `directory`.
+        """(model, meta) from the newest GOOD checkpoint in `directory`.
         Raises FileNotFoundError when none exists (fresh start). Survives
-        torn/missing `latest.json` by scanning the checkpoint zips."""
+        torn/missing `latest.json` by scanning the checkpoint zips, and
+        survives a corrupted newest checkpoint: every candidate's
+        per-entry SHA-256 manifest is verified (and the load itself is
+        allowed to fail) before trusting it — a bit-flipped zip is
+        skipped loudly (`checkpoint_integrity_failures_total`, a
+        `checkpoint_corrupt` flight-recorder event) and the previous
+        good checkpoint is restored instead. When checkpoints EXIST but
+        every one is rejected, the error is NoUsableCheckpointError, not
+        FileNotFoundError — an `except FileNotFoundError: fresh_start()`
+        caller must not silently rebuild over a corrupted history."""
         from deeplearning4j_tpu.utils.model_serializer import load_model
 
-        found = latest_checkpoint(directory)
-        if found is None:
-            raise FileNotFoundError(f"no checkpoint in {directory!r}")
-        path, meta = found
-        t0 = time.perf_counter()
-        with _tracing.span("checkpoint/load", file=meta.get("file")):
-            model = load_model(path, load_updater=load_updater)
-        _metrics.get_registry().histogram(
-            "checkpoint_load_seconds",
-            "checkpoint restore duration").observe(time.perf_counter() - t0)
-        return model, meta
+        for path, meta in verified_checkpoints(directory):
+            t0 = time.perf_counter()
+            try:
+                with _tracing.span("checkpoint/load", file=meta.get("file")):
+                    model = load_model(path, load_updater=load_updater)
+            except Exception as e:
+                note_bad_checkpoint(
+                    path, f"load failed: {type(e).__name__}: {e}")
+                continue
+            _metrics.get_registry().histogram(
+                "checkpoint_load_seconds",
+                "checkpoint restore duration").observe(
+                    time.perf_counter() - t0)
+            return model, meta
+        if any(True for _ in checkpoint_candidates(directory)):
+            raise NoUsableCheckpointError(
+                f"checkpoints exist in {directory!r} but every candidate "
+                f"was rejected (see checkpoint_integrity_failures_total "
+                f"and the checkpoint_corrupt events)")
+        raise FileNotFoundError(f"no checkpoint in {directory!r}")

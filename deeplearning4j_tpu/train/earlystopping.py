@@ -130,10 +130,19 @@ class MaxScoreIterationTerminationCondition(IterationTerminationCondition):
 
 class InvalidScoreIterationTerminationCondition(IterationTerminationCondition):
     """Abort on NaN/Inf score (reference:
-    InvalidScoreIterationTerminationCondition)."""
+    InvalidScoreIterationTerminationCondition).
+
+    Detection routes through train/sentinel.check_score — the ONE
+    non-finite classification path — so a termination here lands in the
+    same books as an in-fit sentinel anomaly:
+    `train_anomaly_total{kind="nonfinite_loss"}` plus a flight-recorder
+    event, instead of a silent ad-hoc isfinite."""
 
     def terminate(self, iteration, score):
-        return not np.isfinite(score)
+        from deeplearning4j_tpu.train import sentinel as _sentinel
+
+        return _sentinel.check_score(iteration, score,
+                                     origin="earlystopping")
 
     def __repr__(self):
         return "InvalidScoreIterationTerminationCondition()"

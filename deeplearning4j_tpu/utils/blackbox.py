@@ -437,6 +437,38 @@ def render_dump(doc: dict, max_steps: int = 32,
                 lines.append(
                     f"    {b.get('nbytes', 0) / 2**20:9.2f} MiB  "
                     f"{b.get('dtype')}{list(b.get('shape') or ())}")
+    # numerical-resilience trail (train/sentinel + checkpoint
+    # integrity): one summary block so a dump answers "did this run
+    # fight divergence / corruption, and how did that end" at a glance
+    # — the individual events stay in the timeline above
+    _RESIL = ("train_anomaly", "batch_quarantined",
+              "quarantined_batch_skipped", "train_rollback",
+              "training_diverged", "checkpoint_corrupt")
+    resil = [ev for ev in events if ev.get("kind") in _RESIL]
+    if resil:
+        lines.append("")
+        lines.append("numerical resilience:")
+        counts: Dict[str, int] = {}
+        for ev in resil:
+            counts[ev["kind"]] = counts.get(ev["kind"], 0) + 1
+        lines.append("  " + "  ".join(
+            f"{k}={counts[k]}" for k in _RESIL if k in counts))
+        for ev in resil:
+            if ev.get("kind") == "batch_quarantined":
+                lines.append(
+                    f"  quarantined: epoch {ev.get('epoch')} batch "
+                    f"{ev.get('batch_in_epoch')} ({ev.get('anomaly')}, "
+                    f"iteration {ev.get('iteration')})")
+            elif ev.get("kind") == "train_rollback":
+                lines.append(
+                    f"  rollback #{ev.get('attempt')} -> "
+                    f"{ev.get('directory')} (lr {ev.get('lr')})")
+            elif ev.get("kind") == "checkpoint_corrupt":
+                lines.append(f"  corrupt checkpoint skipped: "
+                             f"{ev.get('checkpoint')} — {ev.get('why')}")
+            elif ev.get("kind") == "training_diverged":
+                lines.append(f"  DIVERGED: {ev.get('why')} "
+                             f"(dump {ev.get('dump')})")
     deltas = doc.get("metrics_deltas") or []
     if deltas:
         lines.append("")

@@ -29,7 +29,15 @@ sequence lived in a shell history. This module makes faults data:
   so the real OOM-forensics path fires, `latency` sleeps, `hang` blocks
   until released or `hang_seconds` passes — long enough to trip the
   watchdog, bounded so a chaos run can never wedge the harness
-  itself), and a schedule: `every_nth=N` (every Nth invocation
+  itself; `nan` and `corrupt` are COOPERATIVE kinds: `fault_point`
+  returns the kind instead of raising, and the call site applies the
+  damage through its real data path — `train_step` taints the batch's
+  features with NaN so the divergence sentinel sees a genuine
+  non-finite loss, `ckpt_write` byte-flips the written zip entry so
+  checkpoint integrity verification sees genuine corruption; at call
+  sites that don't honor them they are recorded but inert, which a
+  plan author should treat like the vacuously-green rule warning
+  above), and a schedule: `every_nth=N` (every Nth invocation
   of the point), `between=(a, b)` (invocation indices a..b inclusive),
   or `p=0.1` (an independent coin per invocation, drawn from a RNG
   seeded by (plan seed, point, rule index) — NOT wall-clock, NOT a
@@ -58,7 +66,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
-KINDS = ("error", "latency", "hang", "oom")
+KINDS = ("error", "latency", "hang", "oom", "nan", "corrupt")
 
 # the sanctioned point names — fault_point() accepts any name (a new
 # call site should not need a registry edit to exist), but plans naming
@@ -321,16 +329,18 @@ class active:
         return False
 
 
-def fault_point(point: str, **ctx) -> None:
+def fault_point(point: str, **ctx) -> Optional[str]:
     """The call-site hook. No plan: one global read, zero cost. With a
     plan: count the invocation, fire the first matching rule — raise
-    (error), sleep (latency), or block until release/timeout (hang)."""
+    (error/oom), sleep (latency), block until release/timeout (hang),
+    or RETURN the kind for the cooperative kinds (`nan`, `corrupt`) the
+    call site applies through its own data path."""
     plan = _PLAN
     if plan is None:
-        return
+        return None
     decision = plan.decide(point)
     if decision is None:
-        return
+        return None
     rule, inv = decision
     _observe(point, rule.kind, inv, ctx)
     if rule.kind == "error":
@@ -339,11 +349,31 @@ def fault_point(point: str, **ctx) -> None:
         raise InjectedOOM(point, inv)
     if rule.kind == "latency":
         time.sleep(rule.latency_ms / 1e3)
-        return
+        return None
+    if rule.kind in ("nan", "corrupt"):
+        return rule.kind
     # hang: block far past any stall budget, but bounded — an injected
     # hang must be able to trip the watchdog without being able to wedge
     # the chaos harness itself
     plan._release.wait(rule.hang_seconds)
+    return None
+
+
+def taint_nan(ds) -> None:
+    """Apply a fired `nan` fault to a batch: poison its (first) feature
+    array with NaN so the divergence flows through the REAL dispatch —
+    forward, loss, backward — exactly as an organic numerical failure
+    would (the sentinel then sees a genuinely non-finite loss/grad
+    norm, not a synthetic flag). Works on host numpy and staged device
+    arrays alike (`x + nan` builds a new array; the DataSet attribute
+    is re-pointed, which the fit closure reads)."""
+    feats = getattr(ds, "features", None)
+    if isinstance(feats, (list, tuple)):  # MultiDataSet
+        if not feats:
+            return
+        ds.features = [feats[0] + float("nan")] + list(feats[1:])
+    elif feats is not None:
+        ds.features = feats + float("nan")
 
 
 def _observe(point: str, kind: str, invocation: int, ctx: dict):

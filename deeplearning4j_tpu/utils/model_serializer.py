@@ -17,6 +17,7 @@ averaging/serving interchange format.
 
 from __future__ import annotations
 
+import hashlib
 import io
 import json
 import os
@@ -37,6 +38,12 @@ _UPDATER_STATE_NPZ = "updaterState.npz"
 _LAYER_STATE_NPZ = "layerState.npz"
 _META = "meta.json"
 _TRAIN_STATE = "trainState.json"
+# per-entry SHA-256 digests, written LAST so it covers every other
+# entry — the integrity manifest restore paths verify before trusting
+# a checkpoint (zip CRC-32 catches some flips on read; the manifest
+# catches them BEFORE deserialization, names the damaged entry, and
+# survives format evolution explicitly)
+_MANIFEST = "manifest.json"
 
 
 def _tree_to_npz_bytes(tree) -> bytes:
@@ -107,6 +114,15 @@ def _unflatten_tree(template, vec: np.ndarray):
     return jax.tree_util.tree_unflatten(treedef, out)
 
 
+class ConfigMismatchError(ValueError):
+    """A checkpoint was written from a DIFFERENT configuration than the
+    net it is being restored into. Deliberately its own type: the
+    corruption-fallback restore loop must re-raise this (a changed
+    architecture is a user error every candidate will repeat — silently
+    'starting fresh' would discard the whole checkpoint history), while
+    bit-rot/load failures fall through to the previous candidate."""
+
+
 class ModelSnapshot:
     """Point-in-time capture of everything a model zip holds, split so
     async checkpointing can separate the two costs: `capture()` grabs
@@ -154,17 +170,27 @@ class ModelSnapshot:
             "coefficients_dtype": coeffs.dtype.str,  # e.g. "<f4", "<f8"
         }
         with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
-            zf.writestr(_CONFIG_JSON, self.conf_json)
-            zf.writestr(_META, json.dumps(meta, indent=2))
-            zf.writestr(
-                _COEFFICIENTS,
+            digests = {}
+
+            def put(name: str, data):
+                # digest the exact bytes the entry stores — the
+                # integrity manifest verify_checkpoint() checks on load
+                if isinstance(data, str):
+                    data = data.encode("utf-8")
+                digests[name] = hashlib.sha256(data).hexdigest()
+                zf.writestr(name, data)
+
+            put(_CONFIG_JSON, self.conf_json)
+            put(_META, json.dumps(meta, indent=2))
+            put(_COEFFICIENTS,
                 coeffs.astype(coeffs.dtype.newbyteorder("<")).tobytes())
-            zf.writestr(_LAYER_STATE_NPZ, _tree_to_npz_bytes(self.state_list))
+            put(_LAYER_STATE_NPZ, _tree_to_npz_bytes(self.state_list))
             if self.save_updater:
-                zf.writestr(_UPDATER_STATE_NPZ,
-                            _tree_to_npz_bytes(self.upd_state))
+                put(_UPDATER_STATE_NPZ, _tree_to_npz_bytes(self.upd_state))
             if self.train_state is not None:
-                zf.writestr(_TRAIN_STATE, json.dumps(self.train_state))
+                put(_TRAIN_STATE, json.dumps(self.train_state))
+            zf.writestr(_MANIFEST, json.dumps(
+                {"algorithm": "sha256", "entries": digests}, indent=1))
 
 
 def save_model(net, path: Union[str, os.PathLike], save_updater: bool = True,
@@ -243,6 +269,66 @@ def load_model(path: Union[str, os.PathLike], load_updater: bool = True):
     return net
 
 
+def verify_checkpoint(path: Union[str, os.PathLike]) -> dict:
+    """Integrity check of a model/checkpoint zip against its per-entry
+    SHA-256 manifest. Returns:
+
+        {"ok": bool, "legacy": bool, "algorithm": "sha256"|None,
+         "entries": {name: {"status": ..., ...}}}
+
+    Per-entry status: `ok`, `mismatch` (digest differs — a bit flip),
+    `unreadable` (the zip layer itself rejects the entry — torn or
+    CRC-failing bytes), `missing` (listed in the manifest, absent from
+    the zip), `unlisted` (present but never digested — not written by
+    this writer). Pre-digest (legacy) zips have no manifest: they report
+    `legacy=True` with `ok=True` — graceful, nothing to verify against,
+    and the restore paths treat them exactly as before this existed.
+    A zip that cannot be opened at all reports ok=False with `error`."""
+    out = {"ok": True, "legacy": False, "algorithm": None, "entries": {}}
+    try:
+        with zipfile.ZipFile(path, "r") as zf:
+            names = set(zf.namelist())
+            if _MANIFEST not in names:
+                out["legacy"] = True
+                return out
+            try:
+                man = json.loads(zf.read(_MANIFEST).decode("utf-8"))
+            except Exception as e:
+                out["ok"] = False
+                out["error"] = (f"manifest unreadable: "
+                                f"{type(e).__name__}: {e}")
+                return out
+            out["algorithm"] = man.get("algorithm", "sha256")
+            digests = man.get("entries", {})
+            for name, want in digests.items():
+                if name not in names:
+                    out["entries"][name] = {"status": "missing"}
+                    out["ok"] = False
+                    continue
+                try:
+                    got = hashlib.sha256(zf.read(name)).hexdigest()
+                except Exception as e:
+                    out["entries"][name] = {
+                        "status": "unreadable",
+                        "error": f"{type(e).__name__}: {e}"}
+                    out["ok"] = False
+                    continue
+                if got != want:
+                    out["entries"][name] = {
+                        "status": "mismatch",
+                        "expected": want[:16], "got": got[:16]}
+                    out["ok"] = False
+                else:
+                    out["entries"][name] = {"status": "ok"}
+            for name in sorted(names - set(digests) - {_MANIFEST}):
+                out["entries"][name] = {"status": "unlisted"}
+                out["ok"] = False
+    except Exception as e:
+        out["ok"] = False
+        out["error"] = f"{type(e).__name__}: {e}"
+    return out
+
+
 def read_train_state(path: Union[str, os.PathLike]) -> Optional[dict]:
     """The TrainState dict a checkpoint carries (None for checkpoints
     written without one — plain save_model calls, pre-resume files)."""
@@ -254,7 +340,8 @@ def read_train_state(path: Union[str, os.PathLike]) -> Optional[dict]:
 
 
 def restore_fit_state(net, path: Union[str, os.PathLike],
-                      load_updater: bool = True) -> dict:
+                      load_updater: bool = True,
+                      ignore_lr: bool = False) -> dict:
     """Load a checkpoint zip INTO an existing (already-configured) net:
     params, layer state, updater state, iteration/epoch counters.
     Returns the zip's meta dict with the saved TrainState (or None)
@@ -264,13 +351,23 @@ def restore_fit_state(net, path: Union[str, os.PathLike],
 
     The checkpoint's configuration must match the net's (compared as
     parsed JSON, so formatting drift is ignored): silently resuming a
-    different architecture would train a wrong model."""
+    different architecture would train a wrong model. `ignore_lr`
+    exempts `net_conf.learning_rate` from the comparison — the
+    divergence sentinel's rollback path deliberately backs the rate off
+    between the save and the restore, and the backoff must survive the
+    restore rather than disqualify every checkpoint."""
     net._require_init()
     with zipfile.ZipFile(path, "r") as zf:
         meta = json.loads(zf.read(_META).decode("utf-8"))
         saved_conf = json.loads(zf.read(_CONFIG_JSON).decode("utf-8"))
-        if saved_conf != json.loads(net.conf.to_json()):
-            raise ValueError(
+        live_conf = json.loads(net.conf.to_json())
+        if ignore_lr:
+            for doc in (saved_conf, live_conf):
+                nc = doc.get("net_conf")
+                if isinstance(nc, dict):
+                    nc.pop("learning_rate", None)
+        if saved_conf != live_conf:
+            raise ConfigMismatchError(
                 f"checkpoint {path} was written from a different "
                 f"configuration than this {type(net).__name__} — resume "
                 "into the matching model, or use load_model() to "

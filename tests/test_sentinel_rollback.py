@@ -1,0 +1,590 @@
+"""Numerical-failure resilience (ISSUE 14): the in-graph divergence
+sentinel, bad-batch quarantine, automatic checkpoint rollback, and
+checkpoint integrity verification.
+
+The acceptance loop under test: a seeded `nan` fault taints one batch
+through the REAL dispatch path -> the sentinel reads the in-graph
+[loss, grad_norm] diagnostic, quarantines the batch (pre-step references
+restored), rolls back to the last-good checkpoint, replays past the
+quarantined batch, and the fit completes with a finite final loss —
+bit-identically across two runs of the same plan. Plus: the integrity
+half (per-entry SHA-256 manifests; a byte-flipped newest zip makes every
+restore path fall back — loudly, counted — to the previous good
+checkpoint), the unattached-hook overhead pin, and the unified
+non-finite-score path shared with early stopping.
+"""
+
+import glob
+import math
+import os
+import signal
+import subprocess
+import sys
+import time
+import zipfile
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tests"))
+
+from deeplearning4j_tpu.cli import main as cli_main
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.data.iterators import ListDataSetIterator
+from deeplearning4j_tpu.nn.conf import (
+    DenseLayer,
+    NeuralNetConfiguration,
+    OutputLayer,
+    Updater,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.train import sentinel as sentinel_mod
+from deeplearning4j_tpu.train.checkpoint import (
+    CheckpointListener,
+    corrupt_zip_entry,
+    scan_checkpoints,
+)
+from deeplearning4j_tpu.train.sentinel import (
+    DivergenceSentinel,
+    TrainingDivergedError,
+)
+from deeplearning4j_tpu.utils import faultpoints as fp
+from deeplearning4j_tpu.utils.metrics import get_registry
+from deeplearning4j_tpu.utils.model_serializer import (
+    save_model,
+    verify_checkpoint,
+)
+
+N_IN = 8
+
+
+def _net(seed=7):
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Updater.SGD)
+            .learning_rate(0.05).weight_init("xavier").list()
+            .layer(DenseLayer(n_in=N_IN, n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_in=8, n_out=3, activation="softmax",
+                               loss="mcxent"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _iterator(n=128, seed=0):
+    rng = np.random.default_rng(seed)
+    full = DataSet(rng.standard_normal((n, N_IN)).astype(np.float32),
+                   np.eye(3, dtype=np.float32)[rng.integers(0, 3, n)])
+    return ListDataSetIterator(full, 8)
+
+
+class _ScoreTrail:
+    """(iteration, score) per step — the replay-equality probe."""
+
+    def __init__(self):
+        self.trail = []
+
+    def iteration_done(self, model, iteration, info):
+        self.trail.append((iteration, float(np.asarray(info["score"]()))))
+
+    def on_epoch_start(self, model, epoch):
+        pass
+
+    def on_epoch_end(self, model, epoch):
+        pass
+
+
+def _trails_equal(a, b):
+    """Bit-identical, NaN-aware (the anomalous step's score IS NaN)."""
+    if len(a) != len(b):
+        return False
+    for (ia, sa), (ib, sb) in zip(a, b):
+        if ia != ib:
+            return False
+        if not (sa == sb or (math.isnan(sa) and math.isnan(sb))):
+            return False
+    return True
+
+
+def _divergence_run(ckdir, nan_step=8, **sentinel_kw):
+    net = _net()
+    listener = CheckpointListener(ckdir, every_n_iterations=3,
+                                  every_n_epochs=None, keep_last=5,
+                                  async_save=False)
+    kw = dict(rollback_after=1, max_rollbacks=2)
+    kw.update(sentinel_kw)
+    sent = DivergenceSentinel(**kw)
+    trail = _ScoreTrail()
+    net.set_listeners(listener, trail)
+    net.set_sentinel(sent)
+    plan = fp.FaultPlan(seed=1).add("train_step", "nan",
+                                    between=(nan_step, nan_step))
+    with fp.active(plan):
+        net.fit(_iterator(), epochs=1, async_prefetch=False)
+    return net, sent, trail.trail
+
+
+# -- the acceptance loop ------------------------------------------------------
+
+
+def test_nan_injection_quarantine_rollback_recovers(tmp_path):
+    """Seeded NaN mid-fit -> the batch is quarantined, the run rolls
+    back to the last-good checkpoint, the quarantined batch is skipped
+    on replay, and the fit completes with a finite final loss — with
+    every stage in the books (train_anomaly_total,
+    quarantined_batches_total{quarantined,replay_skipped},
+    train_rollback_total) and an SN001 finding on the sentinel."""
+    reg = get_registry().scalar_values()
+    base_anom = reg.get('train_anomaly_total{kind="nonfinite_loss"}', 0.0)
+    net, sent, trail = _divergence_run(str(tmp_path / "ck"))
+    assert math.isfinite(float(np.asarray(net._score)))
+    assert sent.anomalies == 1
+    assert sent.quarantined == 1
+    assert sent.rollbacks == 1
+    assert len(sent.records) == 1
+    rec = sent.records[0]
+    assert rec["anomaly"] == "nonfinite_loss"
+    assert rec["digest"]  # content hash recorded alongside the position
+    # exactly one NaN score in the trail (the anomalous step), then
+    # recovery: the final scores are finite
+    nans = [s for _, s in trail if math.isnan(s)]
+    assert len(nans) == 1
+    assert math.isfinite(trail[-1][1])
+    sc = get_registry().scalar_values()
+    assert sc['train_anomaly_total{kind="nonfinite_loss"}'] == base_anom + 1
+    assert sc.get('quarantined_batches_total{action="quarantined"}', 0) >= 1
+    assert sc.get('quarantined_batches_total{action="replay_skipped"}',
+                  0) >= 1
+    assert sc.get("train_rollback_total", 0) >= 1
+    assert any(f.code == "SN001" for f in sent.findings)
+
+
+def test_lr_backoff_survives_rollback_restore(tmp_path):
+    """lr_backoff mutates the live config BETWEEN the save and the
+    restore; the rollback restore must exempt the learning rate from
+    its config-equality guard (regression: the backed-off config
+    disqualified every checkpoint -> spurious TrainingDivergedError)
+    and the backed-off rate must survive the restore."""
+    net, sent, _ = _divergence_run(str(tmp_path / "ck"),
+                                   lr_backoff=0.5)
+    assert math.isfinite(float(np.asarray(net._score)))
+    assert sent.rollbacks == 1
+    assert net.net_conf.learning_rate == pytest.approx(0.025)
+
+
+def test_checkpoint_saved_during_anomalous_step_is_rejected(tmp_path):
+    """A CheckpointListener firing INSIDE the anomalous dispatch (before
+    the sentinel judged it) saves the very update quarantine discards.
+    With every_n_iterations=1 such a save always exists; rollback must
+    reject it (tainted iteration) and restore the one before."""
+    net = _net()
+    ckdir = str(tmp_path / "ck")
+    listener = CheckpointListener(ckdir, every_n_iterations=1,
+                                  every_n_epochs=None, keep_last=0,
+                                  async_save=False)
+    sent = DivergenceSentinel(rollback_after=1, max_rollbacks=2)
+    net.set_listeners(listener)
+    net.set_sentinel(sent)
+    base = get_registry().scalar_values().get(
+        "checkpoint_integrity_failures_total", 0.0)
+    plan = fp.FaultPlan(seed=1).add("train_step", "nan", between=(8, 8))
+    with fp.active(plan):
+        net.fit(_iterator(), epochs=1, async_prefetch=False)
+    assert math.isfinite(float(np.asarray(net._score)))
+    # the NaN hit step index 7; the discarded update is iteration 8 —
+    # the checkpoint captured during that dispatch is tainted
+    assert sent.tainted_iterations == {8}
+    # the tainted candidate was rejected (counted on the same fallback
+    # books as corruption) before an older good one restored
+    sc = get_registry().scalar_values()
+    assert sc["checkpoint_integrity_failures_total"] >= base + 1
+
+
+def test_replay_bit_identical(tmp_path):
+    """Two runs of the same seeded plan produce the SAME per-step score
+    sequence — the whole detect/quarantine/rollback/replay loop is a
+    pure function of the seed."""
+    _, _, a = _divergence_run(str(tmp_path / "a"))
+    _, _, b = _divergence_run(str(tmp_path / "b"))
+    assert _trails_equal(a, b), (a, b)
+
+
+def test_sentinel_attached_no_anomaly_is_equivalent(tmp_path):
+    """A sentinel judging a healthy run changes NOTHING: per-step scores
+    are bit-identical to a sentinel-off fit (the diagnostic is computed
+    in-graph either way; judgment only reads it)."""
+    def run(with_sentinel):
+        net = _net()
+        trail = _ScoreTrail()
+        net.set_listeners(trail)
+        if with_sentinel:
+            net.set_sentinel(DivergenceSentinel())
+        net.fit(_iterator(n=64), epochs=1, async_prefetch=False)
+        return trail.trail
+
+    assert _trails_equal(run(False), run(True))
+
+
+def test_unattached_hook_under_10us():
+    """The off-path contract: with no sentinel attached, the fit loop's
+    pre-step hook is one attribute read (same pin as devprof/runledger)."""
+    net = _net()
+    assert net._sentinel is None
+    t0 = time.perf_counter()
+    for _ in range(10_000):
+        sentinel_mod.pre_step(net)
+    per_call = (time.perf_counter() - t0) / 10_000
+    assert per_call < 10e-6, f"pre_step cost {per_call * 1e6:.2f}us"
+
+
+def test_grad_norm_spike_classification():
+    """The rolling-median spike detector, judged against a stub net —
+    steady norms pass, a k x median outlier is anomalous, and the gauge
+    tracks the last judged norm."""
+    sent = DivergenceSentinel(grad_norm_factor=5.0, min_history=4)
+
+    class Stub:
+        iteration = 1
+        _score = None
+        _step_diag = None
+
+    stub = Stub()
+    for i in range(6):
+        stub._step_diag = np.asarray([0.5, 1.0 + 0.01 * i], np.float32)
+        stub.iteration += 1
+        assert sent.judge(stub) == "ok"
+    stub._step_diag = np.asarray([0.5, 50.0], np.float32)
+    assert sent.judge(stub) == "grad_norm_spike"
+    assert sent.streak == 1
+    # a healthy step resets the streak (and the spike never entered the
+    # rolling window — the median stays uncontaminated)
+    stub._step_diag = np.asarray([0.5, 1.02], np.float32)
+    assert sent.judge(stub) == "ok"
+    assert sent.streak == 0
+
+
+def test_no_checkpoint_dir_diverges_with_dump(tmp_path):
+    """rollback_after consecutive anomalies with nowhere to roll back
+    to: a diagnosable TrainingDivergedError carrying the dump path."""
+    net = _net()
+    net.set_sentinel(DivergenceSentinel(rollback_after=1))
+    plan = fp.FaultPlan(seed=1).add("train_step", "nan", between=(3, 3))
+    with fp.active(plan):
+        with pytest.raises(TrainingDivergedError) as ei:
+            net.fit(_iterator(n=64), epochs=1, async_prefetch=False)
+    assert ei.value.dump_path and os.path.exists(ei.value.dump_path)
+
+
+# -- checkpoint integrity -----------------------------------------------------
+
+
+def _fit_with_checkpoints(ckdir, n=96):
+    net = _net()
+    listener = CheckpointListener(ckdir, every_n_iterations=3,
+                                  every_n_epochs=None, keep_last=5,
+                                  async_save=False)
+    net.set_listeners(listener)
+    net.fit(_iterator(n=n), epochs=1, async_prefetch=False)
+    return net
+
+
+def test_corrupt_newest_falls_back_and_is_visible(tmp_path, capsys):
+    """Injected byte flip in the newest zip -> restore_latest verifies
+    the manifest, skips it loudly (counter + checkpoint_corrupt event),
+    and restores the PREVIOUS good checkpoint; the fallback renders in
+    `cli blackbox` under "numerical resilience"."""
+    ckdir = str(tmp_path / "ck")
+    _fit_with_checkpoints(ckdir)
+    cks = scan_checkpoints(ckdir)
+    assert len(cks) >= 2
+    corrupt_zip_entry(os.path.join(ckdir, cks[-1][1]))
+    base = get_registry().scalar_values().get(
+        "checkpoint_integrity_failures_total", 0.0)
+    model, meta = CheckpointListener.restore_latest(ckdir)
+    assert meta["file"] == cks[-2][1]
+    sc = get_registry().scalar_values()
+    assert sc["checkpoint_integrity_failures_total"] == base + 1
+    # the event is in the flight recorder and the blackbox render
+    from deeplearning4j_tpu.utils import blackbox
+
+    dump = str(tmp_path / "dump.json")
+    blackbox.get_recorder().dump(dump, reason="test")
+    rc = cli_main(["blackbox", dump])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "numerical resilience" in out
+    assert "corrupt checkpoint skipped" in out
+
+
+def test_resume_from_corrupt_newest_uses_previous(tmp_path):
+    """fit(resume_from=) over a directory whose newest zip is
+    bit-flipped resumes from the previous good checkpoint and completes
+    — the corruption costs one save interval, not the run."""
+    ckdir = str(tmp_path / "ck")
+    _fit_with_checkpoints(ckdir)
+    cks = scan_checkpoints(ckdir)
+    corrupt_zip_entry(os.path.join(ckdir, cks[-1][1]))
+    net = _net()
+    net.fit(_iterator(), epochs=1, resume_from=ckdir,
+            async_prefetch=False)
+    assert math.isfinite(float(np.asarray(net._score)))
+    # it restored the PREVIOUS checkpoint's iteration, then continued
+    # to the epoch end (16 batches total)
+    assert net.iteration == 16
+
+
+def test_resume_all_candidates_rejected_raises(tmp_path):
+    """Checkpoints EXIST but every one is corrupt: fit(resume_from=)
+    must raise (NoUsableCheckpointError), not silently restart from
+    iteration 0 — a fresh run's saves would GC the corrupt zips,
+    destroying both progress and evidence. An empty directory stays a
+    fresh start."""
+    from deeplearning4j_tpu.train.checkpoint import (
+        NoUsableCheckpointError,
+    )
+
+    ckdir = str(tmp_path / "ck")
+    _fit_with_checkpoints(ckdir)
+    for _, name in scan_checkpoints(ckdir):
+        corrupt_zip_entry(os.path.join(ckdir, name))
+    net = _net()
+    with pytest.raises(NoUsableCheckpointError):
+        net.fit(_iterator(), epochs=1, resume_from=ckdir,
+                async_prefetch=False)
+    # restore_latest draws the same distinction: NOT FileNotFoundError
+    # (the documented fresh-start signal) over a corrupted history
+    with pytest.raises(NoUsableCheckpointError):
+        CheckpointListener.restore_latest(ckdir)
+    with pytest.raises(FileNotFoundError):
+        CheckpointListener.restore_latest(str(tmp_path / "nothing"))
+    # empty directory: unchanged contract — fresh start
+    net2 = _net()
+    net2.fit(_iterator(n=32), epochs=1,
+             resume_from=str(tmp_path / "empty"), async_prefetch=False)
+    assert net2.iteration == 4
+
+
+def test_rebinding_sentinel_to_another_net_clears_run_state(tmp_path):
+    """One sentinel reused on a DIFFERENT net must not position-match
+    the old run's quarantine records against the new run's batches."""
+    net, sent, _ = _divergence_run(str(tmp_path / "ck"))
+    assert sent.records and sent.tainted_iterations
+    other = _net(seed=11)
+    other.set_sentinel(sent)
+    other.fit(_iterator(n=64), epochs=1, async_prefetch=False)
+    # the stale records were cleared at bind time: every batch of the
+    # new net's run dispatched (8 batches -> 8 iterations)
+    assert other.iteration == 8
+    assert not sent.records
+
+
+def test_verify_checkpoint_statuses(tmp_path):
+    """Per-entry verdicts: ok on a clean zip; mismatch when an entry's
+    bytes changed under a valid zip layer; unlisted for entries the
+    manifest never digested; legacy for pre-digest zips."""
+    net = _net()
+    p = str(tmp_path / "m.zip")
+    save_model(net, p)
+    v = verify_checkpoint(p)
+    assert v["ok"] and not v["legacy"]
+    assert all(e["status"] == "ok" for e in v["entries"].values())
+
+    # rewrite one entry with different (valid) bytes -> digest mismatch
+    tampered = str(tmp_path / "tampered.zip")
+    with zipfile.ZipFile(p) as zin, zipfile.ZipFile(tampered, "w") as zout:
+        for name in zin.namelist():
+            data = zin.read(name)
+            if name == "trainState.json" or name == "meta.json":
+                data = data + b" "
+            zout.writestr(name, data)
+        zout.writestr("extra.bin", b"not in the manifest")
+    v = verify_checkpoint(tampered)
+    assert not v["ok"]
+    assert v["entries"]["meta.json"]["status"] == "mismatch"
+    assert v["entries"]["extra.bin"]["status"] == "unlisted"
+
+    # legacy: no manifest at all — graceful, nothing to verify
+    legacy = str(tmp_path / "legacy.zip")
+    with zipfile.ZipFile(p) as zin, zipfile.ZipFile(legacy, "w") as zout:
+        for name in zin.namelist():
+            if name != "manifest.json":
+                zout.writestr(name, zin.read(name))
+    v = verify_checkpoint(legacy)
+    assert v["ok"] and v["legacy"]
+
+
+def test_cli_resume_integrity_preflight(tmp_path, capsys):
+    """`cli resume <dir>`: per-entry digest report, exit 1 on a
+    corrupted newest checkpoint, exit 0 (with a note) on pre-digest
+    legacy checkpoints."""
+    ckdir = str(tmp_path / "ck")
+    _fit_with_checkpoints(ckdir)
+    assert cli_main(["resume", ckdir]) == 0
+    out = capsys.readouterr().out
+    assert "integrity: ok" in out
+
+    cks = scan_checkpoints(ckdir)
+    corrupt_zip_entry(os.path.join(ckdir, cks[-1][1]))
+    assert cli_main(["resume", ckdir]) == 1
+    out = capsys.readouterr().out
+    assert "integrity: FAILED" in out
+    assert "unreadable" in out or "mismatch" in out
+
+    # legacy directory: manifest stripped from a good zip
+    legacy_dir = str(tmp_path / "legacy")
+    os.makedirs(legacy_dir)
+    src = os.path.join(ckdir, cks[-2][1])
+    dst = os.path.join(legacy_dir, cks[-2][1])
+    with zipfile.ZipFile(src) as zin, zipfile.ZipFile(dst, "w") as zout:
+        for name in zin.namelist():
+            if name != "manifest.json":
+                zout.writestr(name, zin.read(name))
+    assert cli_main(["resume", legacy_dir]) == 0
+    out = capsys.readouterr().out
+    assert "no digest manifest" in out
+
+
+def test_sigkill_mid_rollback_resumes_cleanly(tmp_path):
+    """SIGKILL delivered WHILE the rollback restore is in flight (the
+    child holds the rollback-event hook open for the kill window): the
+    checkpoint directory stays consistent — atomic writes, read-only
+    restore — and a fresh process `fit(resume_from=)` completes the run
+    with a finite loss."""
+    child = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "sentinel_child.py")
+    ckdir = str(tmp_path / "ck")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = (os.path.dirname(os.path.dirname(child))
+                         + os.pathsep + env.get("PYTHONPATH", ""))
+    env.pop("T1_BLACKBOX_ARTIFACT", None)
+    proc = subprocess.Popen(
+        [sys.executable, child, "--ckpt-dir", ckdir,
+         "--rollback-hold", "3.0"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env)
+    killed = False
+    try:
+        for line in proc.stdout:
+            if line.startswith("EVENT train_rollback"):
+                proc.send_signal(signal.SIGKILL)
+                killed = True
+                break
+            if line.startswith("FIT DONE"):
+                break
+    finally:
+        try:
+            proc.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
+    assert killed, "child finished before any rollback fired"
+    assert proc.returncode == -signal.SIGKILL
+    assert glob.glob(os.path.join(ckdir, "checkpoint_iter*.zip"))
+
+    import sentinel_child
+
+    net = sentinel_child.build_net()
+    net.fit(sentinel_child.build_iterator(), epochs=1,
+            resume_from=ckdir, async_prefetch=False)
+    assert math.isfinite(float(np.asarray(net._score)))
+    assert net.iteration == 16  # all 16 batches accounted for
+
+
+# -- unified detection path / fault kinds / SLO precursor ---------------------
+
+
+def test_earlystopping_invalid_score_counts_in_sentinel_books():
+    """InvalidScoreIterationTerminationCondition routes through the ONE
+    sentinel classification path: a NaN terminates AND lands in
+    train_anomaly_total{kind="nonfinite_loss"}."""
+    from deeplearning4j_tpu.train.earlystopping import (
+        InvalidScoreIterationTerminationCondition,
+    )
+
+    cond = InvalidScoreIterationTerminationCondition()
+    base = get_registry().scalar_values().get(
+        'train_anomaly_total{kind="nonfinite_loss"}', 0.0)
+    assert cond.terminate(3, 1.25) is False
+    assert cond.terminate(4, float("nan")) is True
+    assert cond.terminate(5, float("inf")) is True
+    sc = get_registry().scalar_values()
+    assert sc['train_anomaly_total{kind="nonfinite_loss"}'] == base + 2
+
+
+def test_taint_nan_poisons_features():
+    ds = DataSet(np.ones((4, 3), np.float32),
+                 np.ones((4, 2), np.float32))
+    fp.taint_nan(ds)
+    assert np.isnan(ds.features).all()
+    assert np.isfinite(ds.labels).all()
+
+
+def test_fault_kind_serde_and_cooperative_return():
+    """`nan`/`corrupt` round-trip through plan JSON and RETURN the kind
+    from fault_point instead of raising."""
+    plan = fp.FaultPlan(seed=3).add("train_step", "nan", between=(1, 1)) \
+        .add("ckpt_write", "corrupt", every_nth=1, max_fires=1)
+    plan2 = fp.FaultPlan.from_json(plan.to_json())
+    assert [r.kind for r in plan2.rules] == ["nan", "corrupt"]
+    with fp.active(plan2):
+        assert fp.fault_point("train_step") == "nan"
+        assert fp.fault_point("train_step") is None  # outside `between`
+        assert fp.fault_point("ckpt_write") == "corrupt"
+        assert fp.fault_point("ckpt_write") is None  # max_fires spent
+    assert [e["kind"] for e in plan2.event_log()] == ["corrupt", "nan"]
+
+
+def test_slo_default_pack_grad_norm_precursor():
+    """The default pack carries a rate-of-change rule on the sentinel's
+    train_grad_norm gauge; a fast ramp fires it (warning), absence of
+    the series never alerts."""
+    from deeplearning4j_tpu.analysis import slo
+
+    rules = slo.default_rule_pack(sample_every=1.0)
+    rule = next(r for r in rules
+                if r.name == "grad_norm_divergence_precursor")
+    assert rule.kind == "rate_of_change"
+    assert rule.severity == "warning"
+    rs = slo.SLORuleSet([rule])
+    # no series -> never violated
+    assert rs.evaluate(0.0, {}) == []
+    # ramp at 100/s for > for_seconds -> fires
+    transitions = []
+    for i in range(6):
+        transitions += rs.evaluate(
+            float(i), {"train_grad_norm": 100.0 * i})
+    assert any(t["to"] == "firing" for t in transitions)
+
+
+@pytest.mark.slow
+def test_chaos_divergence_preset_loop(tmp_path, capsys):
+    """The chaos-loop gate: the divergence preset recovers (exit 0)
+    across several seeds, and two runs of the same seed produce the
+    same event log (replay determinism at the CLI surface)."""
+    import json
+
+    reports = []
+    for seed in (0, 1):
+        for rep in range(2):
+            out = str(tmp_path / f"r{seed}_{rep}.json")
+            rc = cli_main(["chaos", "--preset", "divergence",
+                           "--steps", "16", "--seed", str(seed),
+                           "--json", out])
+            capsys.readouterr()
+            assert rc == 0, f"divergence chaos seed={seed} failed"
+            with open(out) as f:
+                reports.append(json.load(f))
+    assert reports[0]["events"] == reports[1]["events"]
+    for rep in reports:
+        assert rep["outcome"] == "recovered"
+        assert rep["final_score_finite"] is True
+        assert rep["loop_exercised"] is True
+        assert rep["sentinel"]["quarantined"] >= 1
+    # a vacuous plan (the NaN never fires) must FAIL the gate: a finite
+    # final loss without an exercised loop is not a rehearsal
+    plan_path = str(tmp_path / "vacuous.json")
+    with open(plan_path, "w") as f:
+        f.write(fp.FaultPlan(seed=0).add(
+            "train_step", "nan", between=(999, 999)).to_json())
+    rc = cli_main(["chaos", "--preset", "divergence", "--steps", "6",
+                   "--plan", plan_path])
+    capsys.readouterr()
+    assert rc == 1
