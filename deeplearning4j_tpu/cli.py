@@ -185,6 +185,11 @@ def cmd_inference_server(args) -> int:
         argv += ["--warmupShape", args.warmup_shape]
     if args.replicas != 1:
         argv += ["--replicas", str(args.replicas)]
+    if args.decode_slots:
+        argv += ["--decodeSlots", str(args.decode_slots)]
+        if args.decode_eos is not None:
+            argv += ["--decodeEos", str(args.decode_eos)]
+        argv += ["--decodeMaxTokens", str(args.decode_max_tokens)]
     inf_main(argv)
     return 0
 
@@ -1205,9 +1210,113 @@ def _chaos_training(plan, steps: int) -> dict:
     }
 
 
+def _chaos_decode(plan, requests: int, clients: int,
+                  deadline_ms) -> dict:
+    """Decode preset: closed-loop generate() clients against one
+    continuous-batching DecodeEngine under the plan (latency + a hang on
+    the `decode_step` point). Invariants checked: every client
+    terminates inside the budget, the per-tenant books conserve, the
+    watchdog actually TRIPPED on the injected hang (a vacuously-green
+    run fails), the engine ends healthy again, and carried deadlines
+    were shed — not served late — while the step was wedged."""
+    import threading
+
+    import numpy as np
+
+    from deeplearning4j_tpu.models.charlstm import char_lstm_network
+    from deeplearning4j_tpu.parallel.inference import (
+        DeadlineExceeded,
+        RequestRejected,
+    )
+    from deeplearning4j_tpu.serving.decode import DecodeEngine
+    from deeplearning4j_tpu.utils import faultpoints as fp
+    from deeplearning4j_tpu.utils import health as _health
+
+    vocab = 11
+    net = char_lstm_network(vocab_size=vocab, hidden=16, layers=1,
+                            tbptt_length=8)
+    eng = DecodeEngine(net, n_slots=4,
+                       tenant_weights={"a": 2.0, "b": 1.0},
+                       default_max_tokens=6, queue_capacity=64,
+                       health_stall_after=0.6,
+                       component_prefix="chaos_decode")
+    counts = {"ok": 0, "shed": 0, "error": 0}
+    lock = threading.Lock()
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, vocab, size=1 + i % 4).tolist()
+               for i in range(16)]
+    per = max(1, requests // clients)
+    health_seq0 = _health.get_health().last_seq()
+
+    def client(ci):
+        for j in range(per):
+            try:
+                eng.generate_sync(prompts[(ci * 7 + j) % len(prompts)],
+                                  max_new_tokens=3 + j % 4,
+                                  tenant="a" if ci % 2 else "b",
+                                  deadline_ms=deadline_ms)
+                k = "ok"
+            except (DeadlineExceeded, RequestRejected):
+                k = "shed"
+            except Exception:
+                k = "error"
+            with lock:
+                counts[k] += 1
+
+    wedged = []
+    try:
+        # warmup outside the plan: the compile must not eat a hang
+        eng.generate([1, 2], max_new_tokens=2, tenant="a").result(60)
+        with fp.active(plan):
+            threads = [threading.Thread(target=client, args=(i,),
+                                        daemon=True,
+                                        name=f"dl4j-chaos-dec-{i}")
+                       for i in range(clients)]
+            for t in threads:
+                t.start()
+            budget = _chaos_budget(plan)
+            for t in threads:
+                t.join(timeout=budget)
+                if t.is_alive():
+                    wedged.append(t.name)
+        m = eng.metrics()
+        unhealthy = _chaos_unhealthy()
+        tripped = [
+            tr for tr in _health.get_health().transitions_since(health_seq0)
+            if str(tr.get("component", "")).startswith("chaos_decode")
+            and tr.get("to") != "ok"]
+    finally:
+        eng.shutdown()
+    return {
+        "workload": {"requests": per * clients, "clients": clients,
+                     "deadline_ms": deadline_ms, "outcomes": counts},
+        "metrics": {k: m[k] for k in ("admitted", "completed", "shed",
+                                      "failed", "rejected")},
+        "shed_by": m["shed_by"],
+        "tenants": m["tenants"],
+        "conservation_ok": m["conservation_ok"],
+        "watchdog_tripped": bool(tripped),
+        "sheds_during_wedge": m["shed"],
+        # the gate must not be vacuous: the injected hang must have
+        # degraded the engine AND expired carries must have shed
+        "loop_exercised": bool(tripped) and m["shed"] >= 1,
+        "wedged_threads": wedged,
+        "unhealthy_components": unhealthy,
+        "outcome": "wedged" if wedged else "recovered",
+    }
+
+
 def _chaos_default_plan(preset: str, seed: int, steps: int = 24):
     from deeplearning4j_tpu.utils import faultpoints as fp
 
+    if preset == "decode":
+        # steady latency jitter plus ONE hang long enough to trip the
+        # engine's watchdog (stall 0.6s) and outlive every carried
+        # deadline — proving degrade -> shed -> recover end to end
+        return (fp.FaultPlan(seed=seed)
+                .add("decode_step", "latency", p=0.1, latency_ms=15.0)
+                .add("decode_step", "hang", every_nth=25, max_fires=1,
+                     hang_seconds=2.5))
     if preset == "serving":
         # replica_forward only: the preset drives ParallelInference
         # in-process, so an http_handler rule would never fire — exactly
@@ -1371,6 +1480,9 @@ def cmd_chaos(args) -> int:
         if args.preset == "serving":
             report = _chaos_serving(plan, args.requests, args.clients,
                                     args.deadline_ms)
+        elif args.preset == "decode":
+            report = _chaos_decode(plan, args.requests, args.clients,
+                                   args.deadline_ms)
         elif args.preset == "divergence":
             report = _chaos_divergence(plan, args.steps)
         else:
@@ -1505,6 +1617,13 @@ def main(argv=None) -> int:
                    help="feature shape to precompile, e.g. 784 or 28,28,1")
     i.add_argument("--replicas", type=int, default=1,
                    help=">=2 serves through a self-healing ReplicaPool")
+    i.add_argument("--decode-slots", type=int, default=0,
+                   help=">0 mounts the continuous-batching decode "
+                        "engine (POST /generate) with this many slots")
+    i.add_argument("--decode-eos", type=int, default=None,
+                   help="EOS token id ending a generated sequence early")
+    i.add_argument("--decode-max-tokens", type=int, default=64,
+                   help="default max_tokens for /generate requests")
     i.set_defaults(fn=cmd_inference_server)
 
     u = sub.add_parser("ui-server", help="dashboard over a stats file")
@@ -1716,11 +1835,16 @@ def main(argv=None) -> int:
              "(utils/faultpoints; exit 1 on wedge/conservation "
              "violation)")
     ch.add_argument("--preset", required=True,
-                    choices=("serving", "training", "divergence"),
+                    choices=("serving", "training", "divergence",
+                             "decode"),
                     help="workload to run under the plan (divergence: "
                          "seeded NaN-at-step-k fit with the sentinel "
                          "armed — exit 1 unless quarantine/rollback "
-                         "recover a finite final loss)")
+                         "recover a finite final loss; decode: a "
+                         "continuous-batching engine under decode_step "
+                         "latency + hang — exit 1 unless the watchdog "
+                         "degraded/recovered it with carried deadlines "
+                         "shed and books conserved)")
     ch.add_argument("--plan", default=None, metavar="JSON",
                     help="FaultPlan JSON file (default: a built-in plan "
                          "for the preset)")

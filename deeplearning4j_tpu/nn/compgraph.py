@@ -895,6 +895,14 @@ class ComputationGraph(NetworkBase):
         # state (BN running stats) is always read fresh from state_list so
         # streaming matches output() even after an interleaved fit()
         carry = getattr(self, "_rnn_carry", None) or {}
+        # a batch-size change is a NEW stream: drop the stale carry
+        # (same contract as MultiLayerNetwork.rnn_time_step) instead of
+        # leaking a previous caller's hidden state into this one
+        bsz = xs[0].shape[0]
+        if carry and any(v.shape[0] != bsz
+                         for st in carry.values() for v in st.values()):
+            carry = {}
+            self._rnn_carry = None
         states = [
             carry.get(i, {}) if _is_recurrent(lc) else self.state_list[i]
             for i, lc in enumerate(self._layer_confs)
@@ -916,6 +924,11 @@ class ComputationGraph(NetworkBase):
 
     def rnn_clear_previous_state(self):
         self._rnn_carry = None
+
+    def clear_rnn_state(self):
+        """Alias of rnn_clear_previous_state (the MultiLayerNetwork
+        streaming API carries the same name)."""
+        self.rnn_clear_previous_state()
 
     def clone(self) -> "ComputationGraph":
         import copy

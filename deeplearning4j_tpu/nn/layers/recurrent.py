@@ -92,6 +92,33 @@ def _scan_lstm(conf, params, x, ctx, peephole: bool, prefix: str = "", reverse: 
     # (HelperError) and the scan path below runs instead
     from deeplearning4j_tpu.ops.helpers import HelperError, get_helper
 
+    if (x.shape[1] == 1 and ctx.mask is None and not reverse
+            and not ctx.training and ctx.state is not None):
+        # decode fast path: a [b, 1, nIn] STATEFUL inference step — the
+        # serving decode engine's / rnn_time_step's shape — consults the
+        # single-step kernel first. It skips the sequence kernel's VJP
+        # stashes (acts/hprev/cprev) entirely; gated on inference +
+        # streaming state because lstm_step defines no VJP
+        # (ops/pallas_lstm.lstm_step)
+        step_helper = get_helper(
+            "lstm_decode_step", peephole=peephole,
+            gate_act=conf.gate_activation, cell_act=conf.activation,
+        )
+        if step_helper is not None:
+            if peephole:
+                pv = tuple(params[prefix + k].astype(x.dtype)
+                           for k in ("pI", "pF", "pO"))
+            else:
+                zero = jnp.zeros((H,), x.dtype)
+                pv = (zero, zero, zero)
+            try:
+                hF, cF = step_helper(xg[:, 0, :], RW.astype(x.dtype),
+                                     *pv, h0, c0)
+            except HelperError:
+                pass  # fall through to the sequence helper / scan
+            else:
+                return hF[:, None, :], (hF, cF)
+
     helper = get_helper(
         "lstm_sequence", peephole=peephole, mask=ctx.mask,
         gate_act=conf.gate_activation, cell_act=conf.activation,

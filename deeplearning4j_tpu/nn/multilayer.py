@@ -1125,37 +1125,138 @@ class MultiLayerNetwork(NetworkBase):
 
     # -- rnn streaming inference ---------------------------------------------
 
+    def _rnn_layer_size(self, i: int) -> int:
+        conf = self.layer_confs[i]
+        inner = conf.inner if isinstance(conf, L.FrozenLayer) else conf
+        return int(inner.n_out)
+
+    def rnn_zero_carry(self, batch: int) -> dict:
+        """Zero recurrent carry for a `batch`-wide stream: {layer index
+        -> {"h", "c"} [batch, H]} for every recurrent layer — the state
+        a fresh rnn_time_step stream (or a freshly admitted decode slot)
+        starts from. Dtype is the compute dtype, matching the zeros the
+        scan itself would seed."""
+        self._require_init()
+        dt = self.policy.compute_dtype
+        return {
+            i: {"h": jnp.zeros((batch, self._rnn_layer_size(i)), dt),
+                "c": jnp.zeros((batch, self._rnn_layer_size(i)), dt)}
+            for i, c in enumerate(self.layer_confs) if _is_recurrent(c)
+        }
+
+    def _rnn_seed_states(self, carry: dict, batch: int):
+        """Full state list for a streaming step: recurrent layers from
+        `carry` (zero-seeded when absent — host-side, so the jitted
+        program's state STRUCTURE is constant and the first call shares
+        the steady-state trace), everything else fresh from state_list
+        (BN running stats must match output() even after an interleaved
+        fit())."""
+        dt = self.policy.compute_dtype
+        states = []
+        for i, c in enumerate(self.layer_confs):
+            if _is_recurrent(c):
+                st = carry.get(i)
+                if st is None:
+                    H = self._rnn_layer_size(i)
+                    st = {"h": jnp.zeros((batch, H), dt),
+                          "c": jnp.zeros((batch, H), dt)}
+                states.append(st)
+            else:
+                states.append(self.state_list[i])
+        return states
+
     def rnn_time_step(self, x):
         """Stateful streaming inference (reference:
         MultiLayerNetwork.rnnTimeStep). x: [batch, time, nIn] (or
-        [batch, nIn] for a single step)."""
+        [batch, nIn] for a single step).
+
+        The streaming step is jitted with a shape-keyed cache (the same
+        discipline as `output()`: keyed on (batch, time, nIn, dtype),
+        each insertion bumps `output_compile_count`) — a mixed-size
+        stream costs one trace per shape, not one per call. A call whose
+        batch size differs from the carried state starts a NEW stream:
+        the stale carry is dropped (loudly) instead of leaking a
+        previous caller's hidden state into this one."""
         self._require_init()
         xx = jnp.asarray(x)
         single = xx.ndim == 2
         if single:
             xx = xx[:, None, :]
-        # only the recurrent carry persists between calls; non-recurrent
-        # state (BN running stats) is read fresh from state_list so
-        # streaming matches output() even after an interleaved fit()
+        bsz = xx.shape[0]
+        # only the recurrent carry persists between calls
         carry = self._rnn_states or {}
-        states = [
-            carry.get(i, {}) if _is_recurrent(c) else self.state_list[i]
-            for i, c in enumerate(self.layer_confs)
-        ]
-        out, new_states = self._forward(
-            self.params_list, states, self.policy.cast_input(xx),
-            training=False, rng=None, stateful=True,
-        )
+        if carry and any(v.shape[0] != bsz
+                         for st in carry.values() for v in st.values()):
+            logger.warning(
+                "rnn_time_step batch size changed (carried %d, got %d): "
+                "dropping the previous stream's state — call "
+                "clear_rnn_state() between streams to silence this",
+                next(iter(carry.values()))["h"].shape[0], bsz)
+            carry = {}
+            self._rnn_states = None
+        states = self._rnn_seed_states(carry, bsz)
+
+        def make_fn():
+            def fwd(params, states, xx):
+                out, new_states = self._forward(
+                    params, states, self.policy.cast_input(xx),
+                    training=False, rng=None, stateful=True,
+                )
+                return self.policy.cast_output(out), new_states
+
+            return jax.jit(fwd)
+
+        fn = self._cached_output_fn(
+            ("rnn_step", xx.shape, str(xx.dtype)), make_fn)
+        out, new_states = fn(self.params_list, states, xx)
         merged = self._merge_states(states, new_states)
         self._rnn_states = {
             i: merged[i]
             for i, c in enumerate(self.layer_confs) if _is_recurrent(c)
         }
-        out = self.policy.cast_output(out)
         return out[:, 0] if single else out
 
     def rnn_clear_previous_state(self):
         self._rnn_states = None
+
+    def clear_rnn_state(self):
+        """Reset the streaming-inference state — the next rnn_time_step
+        call starts a fresh stream (alias of rnn_clear_previous_state)."""
+        self.rnn_clear_previous_state()
+
+    def rnn_decode_step_fn(self):
+        """Pure single-step decode function for the continuous-batching
+        serving tier (serving/decode.py):
+
+            (params, states, carry, x) -> (new_carry, out)
+
+        `x` is ONE timestep [batch, nIn]; `carry` maps recurrent layer
+        index -> {"h", "c"} [batch, H] (see `rnn_zero_carry`); `states`
+        is the net's state_list (recurrent entries ignored in favor of
+        `carry`); `out` is the post-activation output row [batch, nOut].
+        Closed over the configuration only — params/states/carry are
+        ARGUMENTS, which is what makes the decode engine's zero-downtime
+        weight swap compile-free: the jitted program is keyed on shapes,
+        not parameter values. jit-safe; the caller owns the jit and its
+        cache."""
+        self._require_init()
+        rec = frozenset(
+            i for i, c in enumerate(self.layer_confs) if _is_recurrent(c))
+
+        def step(params, states, carry, x):
+            xx = self.policy.cast_input(x)[:, None, :]
+            st = [carry[i] if i in rec else states[i]
+                  for i in range(len(self.layer_confs))]
+            out, new_states = self._forward(
+                params, st, xx, training=False, rng=None, stateful=True,
+            )
+            new_carry = {
+                i: (new_states[i] if new_states[i] is not None else st[i])
+                for i in rec
+            }
+            return new_carry, self.policy.cast_output(out[:, 0])
+
+        return step
 
     def clone(self) -> "MultiLayerNetwork":
         import copy

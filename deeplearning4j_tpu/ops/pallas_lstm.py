@@ -256,6 +256,68 @@ def _lstm_bwd(res, cts):
 lstm_sequence.defvjp(_lstm_fwd, _lstm_bwd)
 
 
+# -- single-step decode kernel ------------------------------------------------
+# The serving decode engine (serving/decode.py) advances every slot by ONE
+# timestep per dispatch. Routing that through the sequence kernel would
+# emit the VJP stashes (acts/hprev/cprev — 6x the useful output) for a
+# path that never differentiates; this kernel is the inference-only step:
+# one [B,H]x[H,4H] MXU matmul + gate math, h/c in, h/c out.
+
+
+def _step_kernel(xg_ref, rw_ref, pi_ref, pf_ref, po_ref, h0_ref, c0_ref,
+                 h_ref, c_ref):
+    H = h0_ref.shape[-1]
+    h = h0_ref[:].astype(jnp.float32)
+    c = c0_ref[:].astype(jnp.float32)
+    pre = xg_ref[:].astype(jnp.float32) + jnp.dot(
+        h, rw_ref[:].astype(jnp.float32),
+        preferred_element_type=jnp.float32)
+    pi = pi_ref[0].astype(jnp.float32)
+    pf = pf_ref[0].astype(jnp.float32)
+    po = po_ref[0].astype(jnp.float32)
+    i = jax.nn.sigmoid(pre[:, :H] + c * pi)
+    f = jax.nn.sigmoid(pre[:, H:2 * H] + c * pf)
+    g = jnp.tanh(pre[:, 2 * H:3 * H])
+    c_new = f * c + i * g
+    o = jax.nn.sigmoid(pre[:, 3 * H:] + c_new * po)
+    h_ref[:] = (o * jnp.tanh(c_new)).astype(h_ref.dtype)
+    c_ref[:] = c_new.astype(c_ref.dtype)
+
+
+def lstm_step(xg, rw, pI, pF, pO, h0, c0):
+    """One decode timestep, fused. xg: [B, 4H] precomputed input
+    projection + bias; rw: [H, 4H]; pI/pF/pO: [H] peephole vectors
+    (zeros for plain LSTM); h0/c0: [B, H]. Returns (h1, c1).
+    Inference-only: no VJP is defined — the decode path never
+    differentiates."""
+    B, H4 = xg.shape
+    H = H4 // 4
+    dt = xg.dtype
+    whole = lambda shape: pl.BlockSpec(shape, lambda: (0,) * len(shape),
+                                       memory_space=pltpu.VMEM)
+    return pl.pallas_call(
+        _step_kernel,
+        in_specs=[whole((B, H4)), whole((H, H4)),
+                  whole((1, H)), whole((1, H)), whole((1, H)),
+                  whole((B, H)), whole((B, H))],
+        out_specs=[whole((B, H)), whole((B, H))],
+        out_shape=[jax.ShapeDtypeStruct((B, H), dt),
+                   jax.ShapeDtypeStruct((B, H), dt)],
+        interpret=_INTERPRET,
+    )(xg, rw, pI[None, :], pF[None, :], pO[None, :], h0, c0)
+
+
+def step_supported(*, peephole, gate_act, cell_act, **_):
+    """Probe for the single-step decode kernel: same numeric scope as the
+    sequence kernel (sigmoid gates + tanh cell, peepholes optional); the
+    decode call site only consults it for unmasked forward steps."""
+    del peephole
+    if gate_act not in ("sigmoid",) or cell_act not in ("tanh",):
+        return False
+    backend = jax.default_backend()
+    return backend == "tpu" or _INTERPRET
+
+
 def supported(*, peephole, mask, gate_act, cell_act, reverse, **_):
     """Helper probe: the fused kernel covers sigmoid gates + tanh cell,
     forward direction, no time mask (with or without peepholes); anything
@@ -275,6 +337,8 @@ def register():
 
     register_helper("lstm_sequence", lstm_sequence, supported,
                     name="pallas_fused_lstm")
+    register_helper("lstm_decode_step", lstm_step, step_supported,
+                    name="pallas_lstm_step")
 
 
 register()

@@ -120,6 +120,77 @@ class InferenceMode:
     BATCHED = "batched"
 
 
+class AdmissionBooks:
+    """Exact request accounting under the conservation law
+
+        admitted == completed + shed + failed
+
+    with per-"stage/reason" shed breakdowns. Admission REFUSALS land in
+    `rejected`, outside the law — the request never entered the system.
+    Keyed by tenant: ParallelInference books everything under the
+    default (None) tenant; the decode engine (serving/decode.py) keeps
+    one ledger per tenant so multi-tenant hosting's books stay exact
+    per customer. NOT internally locked — callers mutate under their
+    own admission lock, exactly as the inline counters this class
+    replaced were."""
+
+    _KEYS = ("admitted", "completed", "shed", "failed", "rejected")
+
+    def __init__(self):
+        self._tenants: dict = {}
+
+    def _t(self, tenant):
+        t = self._tenants.get(tenant)
+        if t is None:
+            t = self._tenants[tenant] = {
+                "admitted": 0, "completed": 0, "shed": 0, "failed": 0,
+                "rejected": 0, "shed_by": {}}
+        return t
+
+    def admit(self, tenant=None):
+        self._t(tenant)["admitted"] += 1
+
+    def complete(self, tenant=None):
+        self._t(tenant)["completed"] += 1
+
+    def fail(self, tenant=None):
+        self._t(tenant)["failed"] += 1
+
+    def shed(self, stage: str, reason: str, tenant=None,
+             admitted: bool = True):
+        t = self._t(tenant)
+        key = f"{stage}/{reason}"
+        t["shed_by"][key] = t["shed_by"].get(key, 0) + 1
+        t["shed" if admitted else "rejected"] += 1
+
+    def totals(self) -> dict:
+        agg = {k: 0 for k in self._KEYS}
+        agg["shed_by"] = {}
+        for t in self._tenants.values():
+            for k in self._KEYS:
+                agg[k] += t[k]
+            for sb, v in t["shed_by"].items():
+                agg["shed_by"][sb] = agg["shed_by"].get(sb, 0) + v
+        return agg
+
+    def per_tenant(self) -> dict:
+        return {
+            ("default" if t is None else t): {
+                **{k: b[k] for k in self._KEYS},
+                "shed_by": dict(b["shed_by"]),
+                "conservation_ok":
+                    b["admitted"] == b["completed"] + b["shed"] + b["failed"],
+            }
+            for t, b in self._tenants.items()
+        }
+
+    def conservation_ok(self) -> bool:
+        """The law, per tenant AND therefore in aggregate."""
+        return all(
+            t["admitted"] == t["completed"] + t["shed"] + t["failed"]
+            for t in self._tenants.values())
+
+
 class RequestValidationError(ValueError):
     """The REQUEST was malformed (empty, or feature shape mismatching the
     endpoint's) — distinguishes client faults from server-side ValueErrors
@@ -295,17 +366,14 @@ class ParallelInference:
             "batches": 0,
             "oversized": 0,
             "bucket_hits": {b: 0 for b in self.buckets},
-            # exact request accounting (the conservation law):
-            #   admitted == completed + shed + failed
-            # `rejected` counts admission-control refusals — those
-            # happened BEFORE admission, so they sit outside the law
-            "admitted": 0,
-            "completed": 0,
-            "shed": 0,
-            "failed": 0,
-            "rejected": 0,
-            "shed_by": {},  # "stage/reason" -> count
         }
+        # exact request accounting (the conservation law):
+        #   admitted == completed + shed + failed
+        # `rejected` counts admission-control refusals — those happened
+        # BEFORE admission, so they sit outside the law. The shared
+        # AdmissionBooks shape (one default tenant here; the decode
+        # engine books per tenant), mutated under self._lock.
+        self._books = AdmissionBooks()
         # examples currently waiting in _q (admission's queue-depth
         # estimate in GROUP units: examples / max_batch_size)
         self._queued_examples = 0
@@ -578,7 +646,7 @@ class ParallelInference:
                 # concurrent callers go back to shedding: one probe per
                 # staleness window, not a floodgate
                 self._m_probe.inc()
-            self._stats["admitted"] += 1
+            self._books.admit()
             self._m_admitted.inc()
             fut: Optional[Future] = None
             if fusable:
@@ -641,9 +709,7 @@ class ParallelInference:
         sheds land in `shed` (the conservation law's term); admission
         refusals land in `rejected` — the request never entered the
         system. Both feed serving_shed_total{stage,reason}."""
-        key = f"{stage}/{reason}"
-        self._stats["shed_by"][key] = self._stats["shed_by"].get(key, 0) + 1
-        self._stats["shed" if admitted else "rejected"] += 1
+        self._books.shed(stage, reason, admitted=admitted)
         self._m_shed.labels(stage, reason).inc()
 
     def _count_outcome(self, outcome: str, stage: Optional[str] = None,
@@ -652,7 +718,10 @@ class ParallelInference:
             if outcome == "shed":
                 self._shed_locked(stage, reason, admitted=True)
                 return
-            self._stats[outcome] += 1
+            if outcome == "completed":
+                self._books.complete()
+            else:
+                self._books.fail()
         (self._m_completed if outcome == "completed"
          else self._m_failed).inc()
 
@@ -740,12 +809,7 @@ class ParallelInference:
                 "batches": self._stats["batches"],
                 "oversized": self._stats["oversized"],
                 "bucket_hits": dict(self._stats["bucket_hits"]),
-                "admitted": self._stats["admitted"],
-                "completed": self._stats["completed"],
-                "shed": self._stats["shed"],
-                "failed": self._stats["failed"],
-                "rejected": self._stats["rejected"],
-                "shed_by": dict(self._stats["shed_by"]),
+                **self._books.totals(),
             }
         m["buckets"] = list(self.buckets)
         m["max_batch_size"] = self.max_batch_size

@@ -1,9 +1,11 @@
 """Serving entry points: k-NN REST server (reference:
 deeplearning4j-nearestneighbor-server), model-inference REST server
-(bucketed+pipelined ParallelInference behind POST /predict), and
-ParallelInference itself (parallel/)."""
+(bucketed+pipelined ParallelInference behind POST /predict, plus the
+continuous-batching autoregressive decode engine behind POST
+/generate), and ParallelInference itself (parallel/)."""
 
+from deeplearning4j_tpu.serving.decode import DecodeEngine
 from deeplearning4j_tpu.serving.inference_server import InferenceServer
 from deeplearning4j_tpu.serving.knnserver import NearestNeighborsServer
 
-__all__ = ["InferenceServer", "NearestNeighborsServer"]
+__all__ = ["DecodeEngine", "InferenceServer", "NearestNeighborsServer"]

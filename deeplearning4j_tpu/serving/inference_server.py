@@ -66,6 +66,7 @@ from deeplearning4j_tpu.parallel.inference import (
     RequestRejected,
     RequestValidationError,
 )
+from deeplearning4j_tpu.serving.decode import DecodeEngine
 from deeplearning4j_tpu.utils import health as _health
 from deeplearning4j_tpu.utils import metrics as _metrics
 from deeplearning4j_tpu.utils import runledger as _runledger
@@ -93,6 +94,11 @@ class InferenceServer:
         default_deadline_ms: Optional[float] = None,
         request_timeout: float = 30.0,
         run_ledger=None,
+        decode_slots: int = 0,
+        decode_eos_token: Optional[int] = None,
+        decode_max_tokens: int = 64,
+        decode_tenant_weights: Optional[dict] = None,
+        decode_queue_capacity: int = 256,
     ):
         # n_replicas >= 2 turns on the self-healing pool: each replica's
         # collector/dispatcher heartbeats are watched separately, an
@@ -121,6 +127,20 @@ class InferenceServer:
             )
         if warmup_shape is not None:
             self.inference.warmup(warmup_shape)
+        # the autoregressive tier: decode_slots > 0 mounts a continuous-
+        # batching DecodeEngine (serving/decode.py) over the SAME model
+        # and exposes POST /generate behind the same deadline/429
+        # contract as /predict (streaming via chunked ndjson)
+        self.decode = None
+        if int(decode_slots) > 0:
+            self.decode = DecodeEngine(
+                model, n_slots=int(decode_slots),
+                eos_token=decode_eos_token,
+                default_max_tokens=int(decode_max_tokens),
+                default_deadline_ms=default_deadline_ms,
+                tenant_weights=decode_tenant_weights,
+                queue_capacity=int(decode_queue_capacity),
+            )
         # run-ledger opt-in at the server level (works for both the
         # single-PI and ReplicaPool modes): a path builds a RunLedger
         # with the default rule pack derived from THIS server's config
@@ -172,6 +192,10 @@ class InferenceServer:
              "value_ms": round(e["value"] * 1e3, 6),
              "trace_id": e["trace_id"], "ts": e["ts"]}
             for e in self._m_latency.exemplars()]
+        if self.decode is not None:
+            # the autoregressive tier's books on the same scrape: slot
+            # occupancy, per-tenant conservation, token counts, version
+            m["decode"] = self.decode.metrics()
         return m
 
     # -- request handling ----------------------------------------------------
@@ -238,7 +262,48 @@ class InferenceServer:
             return 200, "application/x-ndjson", tracer.to_jsonl(n).encode()
         return None
 
+    @staticmethod
+    def _parse_deadline(req: dict, headers: dict):
+        """The ONE deadline contract for every POST route: the JSON
+        field wins over the X-Deadline-Ms header (case-insensitive —
+        HTTP/2 proxies lowercase it); both are a RELATIVE ms budget.
+        Returns (deadline_ms or None, error_response or None)."""
+        deadline_ms = req.get("deadline_ms")
+        if deadline_ms is None:
+            deadline_ms = next(
+                (v for k, v in headers.items()
+                 if k.lower() == "x-deadline-ms"), None)
+        if deadline_ms is None:
+            return None, None
+        try:
+            deadline_ms = float(deadline_ms)
+        except (TypeError, ValueError):
+            return None, json_response(
+                {"error": f"bad deadline_ms: {deadline_ms!r}"}, 400)
+        if not math.isfinite(deadline_ms):
+            # json.loads parses bare NaN/Infinity; a NaN budget makes
+            # every deadline comparison False — admitted, then shed
+            # with a misleading 429. Malformed input is a 400.
+            return None, json_response(
+                {"error": f"deadline_ms must be finite, "
+                          f"got {deadline_ms!r}"}, 400)
+        return deadline_ms, None
+
+    @staticmethod
+    def _shed_response(e):
+        """Shed, not failed: 429 + Retry-After (integer delta-seconds
+        per RFC 9110; the body keeps ms precision)."""
+        retry_after = max(0.05, getattr(e, "retry_after", 0.0) or 0.05)
+        return json_response(
+            {"error": str(e), "shed": True,
+             "stage": getattr(e, "stage", "admission"),
+             "retry_after_ms": round(retry_after * 1e3, 1)},
+            429,
+            headers={"Retry-After": str(max(1, math.ceil(retry_after)))})
+
     def _post(self, path, body, headers):
+        if path == "/generate":
+            return self._post_generate(body, headers)
         if path != "/predict":
             return None
         req = json.loads(body or b"{}")
@@ -254,29 +319,9 @@ class InferenceServer:
         single = feats.ndim == 1
         if single:
             feats = feats[None]
-        # deadline: JSON field wins over the X-Deadline-Ms header; both
-        # are a RELATIVE budget in ms from arrival (clients with clock
-        # skew cannot express an absolute deadline honestly). Header
-        # names compare case-insensitively (RFC 9110) — an HTTP/2 proxy
-        # in front of this server lowercases them
-        deadline_ms = req.get("deadline_ms")
-        if deadline_ms is None:
-            deadline_ms = next(
-                (v for k, v in headers.items()
-                 if k.lower() == "x-deadline-ms"), None)
-        if deadline_ms is not None:
-            try:
-                deadline_ms = float(deadline_ms)
-            except (TypeError, ValueError):
-                return json_response(
-                    {"error": f"bad deadline_ms: {deadline_ms!r}"}, 400)
-            if not math.isfinite(deadline_ms):
-                # json.loads parses bare NaN/Infinity; a NaN budget makes
-                # every deadline comparison False — admitted, then shed
-                # with a misleading 429. Malformed input is a 400.
-                return json_response(
-                    {"error": f"deadline_ms must be finite, "
-                              f"got {deadline_ms!r}"}, 400)
+        deadline_ms, err = self._parse_deadline(req, headers)
+        if err is not None:
+            return err
         t0 = time.perf_counter()
         try:
             # the request's serving span: nests under jsonhttp's
@@ -290,17 +335,8 @@ class InferenceServer:
             return json_response({"error": str(e)}, 400)
         except (RequestRejected, DeadlineExceeded) as e:
             # shed, not failed: 429 tells clients/load-balancers to back
-            # off and retry later (Retry-After carries the server's wait
-            # estimate); 503 stays reserved for GET /health degradation
-            retry_after = max(0.05, getattr(e, "retry_after", 0.0) or 0.05)
-            # the header must be integer delta-seconds (RFC 9110) or
-            # conforming clients drop it; the body keeps the precision
-            return json_response(
-                {"error": str(e), "shed": True,
-                 "stage": getattr(e, "stage", "admission"),
-                 "retry_after_ms": round(retry_after * 1e3, 1)},
-                429,
-                headers={"Retry-After": str(max(1, math.ceil(retry_after)))})
+            # off and retry later; 503 stays reserved for GET /health
+            return self._shed_response(e)
         except Exception as e:
             # anything else (shutdown race, model/XLA failure — including
             # server-side ValueErrors) is a server fault: 500, so
@@ -326,6 +362,107 @@ class InferenceServer:
                 preds = (out[0] if single else out).tolist()
             return json_response({"predictions": preds})
 
+    def _post_generate(self, body, headers):
+        """POST /generate — the autoregressive decode route.
+
+            {"prompt": [token ids...], "max_tokens": 32,
+             "tenant": "...", "deadline_ms": 500, "stream": false}
+
+        Non-streaming: one JSON body {"tokens": [...], "version": v}.
+        `"stream": true`: a chunked application/x-ndjson response — one
+        {"token": id} line per emitted token as it is produced, closed
+        by a {"done": true, "tokens": [...]} line (or an {"error": ...}
+        line if the request was shed mid-decode). Same deadline/429
+        contract as /predict."""
+        if self.decode is None:
+            return json_response(
+                {"error": "decode engine not enabled (start the server "
+                          "with decode_slots > 0 / --decodeSlots)"}, 404)
+        req = json.loads(body or b"{}")
+        if "prompt" not in req:
+            return json_response({"error": "missing 'prompt'"}, 400)
+        deadline_ms, err = self._parse_deadline(req, headers)
+        if err is not None:
+            return err
+        tenant = str(req.get("tenant", "default"))
+        max_tokens = req.get("max_tokens")
+        if max_tokens is not None:
+            try:
+                max_tokens = int(max_tokens)
+            except (TypeError, ValueError):
+                return json_response(
+                    {"error": f"bad max_tokens: {max_tokens!r}"}, 400)
+        kw = dict(max_new_tokens=max_tokens, tenant=tenant,
+                  deadline_ms=deadline_ms)
+        stream = bool(req.get("stream", False))
+        t0 = time.perf_counter()
+        try:
+            with _tracing.span("serve/generate", tenant=tenant,
+                               stream=stream):
+                if not stream:
+                    toks = self.decode.generate_sync(req["prompt"], **kw)
+                    self.latency.record(time.perf_counter() - t0)
+                    return json_response(
+                        {"tokens": toks,
+                         "version": self.decode.version})
+                import queue as _queue
+
+                emitted: "_queue.Queue" = _queue.Queue()
+                fut = self.decode.generate(
+                    req["prompt"], on_token=emitted.put_nowait, **kw)
+        except RequestValidationError as e:
+            return json_response({"error": str(e)}, 400)
+        except (RequestRejected, DeadlineExceeded) as e:
+            return self._shed_response(e)
+        except Exception as e:
+            return json_response({"error": f"{type(e).__name__}: {e}"},
+                                 500)
+
+        # the wedged-engine backstop the non-streaming route gets from
+        # generate_sync: a deadline-carrying stream gives up (and sheds,
+        # race-safely — the engine's own shed may win) a grace past its
+        # deadline instead of pinning the handler thread forever
+        from deeplearning4j_tpu.serving.decode import _WAIT_SHED_GRACE
+
+        give_up = (None if deadline_ms is None
+                   else t0 + float(deadline_ms) / 1e3 + _WAIT_SHED_GRACE)
+
+        def lines():
+            # drain tokens as the engine emits them; the final line
+            # carries the whole-request verdict (mid-stream sheds can
+            # no longer change the status code — it is on the wire)
+            while True:
+                try:
+                    t = emitted.get(timeout=0.05)
+                except _queue.Empty:
+                    if fut.done() and emitted.empty():
+                        break
+                    if give_up is not None \
+                            and time.perf_counter() >= give_up:
+                        self.decode._fail(
+                            fut,
+                            DeadlineExceeded(
+                                "deadline expired waiting on a stalled "
+                                "decode engine", stage="wait"),
+                            tenant, outcome="shed", stage="wait",
+                            reason="expired")
+                        break
+                    continue
+                yield (json.dumps({"token": int(t)}) + "\n").encode()
+            try:
+                toks = fut.result(timeout=0)
+                yield (json.dumps(
+                    {"done": True, "tokens": toks,
+                     "version": self.decode.version}) + "\n").encode()
+            except Exception as e:
+                yield (json.dumps(
+                    {"error": f"{type(e).__name__}: {e}",
+                     "shed": isinstance(
+                         e, (RequestRejected, DeadlineExceeded))})
+                    + "\n").encode()
+
+        return 200, "application/x-ndjson", lines()
+
     # -- lifecycle -----------------------------------------------------------
 
     def start(self) -> int:
@@ -333,6 +470,8 @@ class InferenceServer:
 
     def stop(self):
         self._server.stop()
+        if self.decode is not None:
+            self.decode.shutdown()
         self.inference.shutdown()
         if self._owned_ledger is not None:
             self._owned_ledger.close()
@@ -378,6 +517,14 @@ def main(argv=None):
                     help="record a persistent run ledger (metrics "
                          "samples + SLO rule verdicts) to this path; "
                          "GET /alerts serves the live rule states")
+    ap.add_argument("--decodeSlots", type=int, default=0,
+                    help=">0 mounts the continuous-batching decode "
+                         "engine (POST /generate) with this many slots "
+                         "(recurrent models only)")
+    ap.add_argument("--decodeEos", type=int, default=None,
+                    help="EOS token id ending a generated sequence early")
+    ap.add_argument("--decodeMaxTokens", type=int, default=64,
+                    help="default max_tokens for /generate requests")
     args = ap.parse_args(argv)
     from deeplearning4j_tpu.cli import guess_and_load_model
 
@@ -394,6 +541,9 @@ def main(argv=None):
         default_deadline_ms=args.defaultDeadlineMs,
         request_timeout=args.requestTimeout,
         run_ledger=args.ledger,
+        decode_slots=args.decodeSlots,
+        decode_eos_token=args.decodeEos,
+        decode_max_tokens=args.decodeMaxTokens,
     )
     # operator surface: opt in to real log output, then announce through
     # the package logger (library code never prints — lint CC006)

@@ -81,6 +81,7 @@ KNOWN_POINTS = (
     "replica_forward",
     "http_handler",
     "train_step",
+    "decode_step",
 )
 
 

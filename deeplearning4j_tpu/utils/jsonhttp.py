@@ -40,6 +40,9 @@ from deeplearning4j_tpu.utils import tracing as _tracing
 #   (status, content_type, payload_bytes)            or
 #   (status, content_type, payload_bytes, extra_headers_dict)  or
 #   None for "no such route"
+# A payload that is an ITERATOR of byte chunks (not bytes) streams back
+# as a chunked HTTP/1.1 response — each chunk is flushed as produced
+# (the decode engine's /generate token stream rides this).
 Handler = Callable[[str, bytes, dict], Optional[Tuple]]
 
 
@@ -178,15 +181,51 @@ class JsonHttpServer:
                                 {"error": f"{type(e).__name__}: {e}"}, 400)
                         code, ctype, payload = out[:3]
                         extra = out[3] if len(out) > 3 else None
-                        self.send_response(code)
-                        self.send_header("Content-Type", ctype)
-                        self.send_header("Content-Length",
-                                         str(len(payload)))
-                        if extra:
-                            for k, v in extra.items():
-                                self.send_header(k, str(v))
-                        self.end_headers()
-                        self.wfile.write(payload)
+                        if isinstance(payload, (bytes, bytearray)):
+                            self.send_response(code)
+                            self.send_header("Content-Type", ctype)
+                            self.send_header("Content-Length",
+                                             str(len(payload)))
+                            if extra:
+                                for k, v in extra.items():
+                                    self.send_header(k, str(v))
+                            self.end_headers()
+                            self.wfile.write(payload)
+                        else:
+                            # streaming payload. A client that spoke
+                            # HTTP/1.1 gets chunked framing (per-request
+                            # protocol upgrade; the Content-Length path
+                            # above stays 1.0); an HTTP/1.0 client
+                            # cannot de-frame chunks, so it gets the raw
+                            # flushed body with read-to-close framing.
+                            chunked = self.request_version != "HTTP/1.0"
+                            if chunked:
+                                self.protocol_version = "HTTP/1.1"
+                            self.send_response(code)
+                            self.send_header("Content-Type", ctype)
+                            if chunked:
+                                self.send_header("Transfer-Encoding",
+                                                 "chunked")
+                            if extra:
+                                for k, v in extra.items():
+                                    self.send_header(k, str(v))
+                            self.end_headers()
+                            for chunk in payload:
+                                if not chunk:
+                                    continue
+                                chunk = bytes(chunk)
+                                if chunked:
+                                    chunk = (b"%x\r\n" % len(chunk)
+                                             + chunk + b"\r\n")
+                                self.wfile.write(chunk)
+                                self.wfile.flush()
+                            if chunked:
+                                self.wfile.write(b"0\r\n\r\n")
+                            # one response per connection for streamed
+                            # bodies: the peer reads to the terminal
+                            # chunk (or to close); keep-alive buys
+                            # nothing here
+                            self.close_connection = True
                 finally:
                     if traced:
                         _tracing.detach(tok)

@@ -44,6 +44,23 @@ else
     exit 1
 fi
 
+# -- decode-engine smoke ------------------------------------------------------
+# The continuous-batching autoregressive tier (serving/decode.py): a tiny
+# charlstm engine with 4 slots and 2 weighted tenants serves mixed
+# prompts through one live weight swap — asserting per-tenant book
+# conservation AND a constant program cache after warmup (zero retraces
+# across admissions and the swap: the O(1)-compile contract).
+rm -f /tmp/_t1_decode.log
+if timeout -k 10 180 env JAX_PLATFORMS=cpu \
+    python -m deeplearning4j_tpu.serving.decode --smoke \
+    > /tmp/_t1_decode.log 2>&1; then
+    echo "T1 DECODE SMOKE: ok (4 slots, 2 tenants, 1 weight swap, zero retraces)"
+else
+    echo "T1 DECODE SMOKE: FAILED — tail of /tmp/_t1_decode.log:"
+    tail -20 /tmp/_t1_decode.log
+    exit 1
+fi
+
 # -- the canonical tier-1 pytest run -----------------------------------------
 # T1_METRICS_DUMP=1 makes tests/conftest.py write the shared metrics
 # registry's snapshot after the session (T1_METRICS_ARTIFACT, default

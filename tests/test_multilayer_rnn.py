@@ -101,3 +101,47 @@ def test_variable_length_masking():
     x2[:, 7:] += 100.0
     ds2 = DataSet(x2, y, features_mask=mask, labels_mask=mask)
     assert abs(net.score(ds2) - net.score(ds)) < 1e-5
+
+
+def test_rnn_time_step_shape_keyed_compile_cache():
+    """The streaming step is jitted with a shape-keyed cache: repeated
+    same-shape calls cost ZERO new traces (a serving decode loop must
+    not retrace per call), and each distinct (batch, time) shape costs
+    exactly one."""
+    x, _ = seq_data(4, 6)
+    net = MultiLayerNetwork(rnn_conf()).init()
+    net.rnn_time_step(x[:, :3])
+    c0 = net.output_compile_count
+    net.rnn_time_step(x[:, 3:])  # same [4, 3, 1] shape: cached
+    for _ in range(5):
+        net.clear_rnn_state()
+        net.rnn_time_step(x[:, :3])
+    assert net.output_compile_count == c0
+    net.rnn_time_step(x)  # new time length: exactly one new trace
+    assert net.output_compile_count == c0 + 1
+
+
+def test_rnn_time_step_batch_change_starts_fresh_stream():
+    """Regression: a batch-size change used to crash (or silently leak)
+    against the previous caller's carried h/c. Now it starts a NEW
+    stream — identical to calling clear_rnn_state() first."""
+    x, _ = seq_data(4, 6)
+    net = MultiLayerNetwork(rnn_conf()).init()
+    net.rnn_time_step(x)  # carry now holds batch-4 state
+    out = np.asarray(net.rnn_time_step(x[:2]))  # batch 2: new stream
+    net.clear_rnn_state()
+    fresh = np.asarray(net.rnn_time_step(x[:2]))
+    np.testing.assert_array_equal(out, fresh)
+
+
+def test_clear_rnn_state_resets_stream():
+    """clear_rnn_state() regression: without it, carried state makes a
+    repeat call differ; with it, the repeat is bit-identical."""
+    x, _ = seq_data(4, 6)
+    net = MultiLayerNetwork(rnn_conf()).init()
+    a = np.asarray(net.rnn_time_step(x))
+    b = np.asarray(net.rnn_time_step(x))  # carried h/c: different output
+    assert not np.allclose(a, b)
+    net.clear_rnn_state()
+    c = np.asarray(net.rnn_time_step(x))
+    np.testing.assert_array_equal(a, c)

@@ -197,3 +197,54 @@ def test_network_tbptt_uses_helper_and_matches_scan():
             np.testing.assert_allclose(
                 np.asarray(p1[k]), np.asarray(p2[k]), rtol=2e-4, atol=2e-5,
                 err_msg=f"TBPTT param {k}")
+
+
+@pytest.mark.parametrize("with_peepholes", [False, True])
+def test_step_kernel_matches_scan_single_step(with_peepholes):
+    """The inference-only decode step kernel (lstm_step — no VJP
+    stashes) computes exactly one scan step."""
+    rng = np.random.default_rng(3)
+    B, H = 8, 16
+    xg = jnp.asarray(rng.standard_normal((B, 4 * H)), jnp.float32)
+    rw = jnp.asarray(rng.standard_normal((H, 4 * H)) * 0.2, jnp.float32)
+    if with_peepholes:
+        pI, pF, pO = (jnp.asarray(rng.standard_normal(H) * 0.3, jnp.float32)
+                      for _ in range(3))
+    else:
+        pI = pF = pO = jnp.zeros((H,), jnp.float32)
+    h0 = jnp.asarray(rng.standard_normal((B, H)) * 0.1, jnp.float32)
+    c0 = jnp.asarray(rng.standard_normal((B, H)) * 0.1, jnp.float32)
+    h1, c1 = pallas_lstm.lstm_step(xg, rw, pI, pF, pO, h0, c0)
+    ys, hF, cF = _scan_reference(xg[None], rw, pI, pF, pO, h0, c0)
+    np.testing.assert_allclose(np.asarray(h1), np.asarray(hF), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(c1), np.asarray(cF), atol=1e-6)
+
+
+def test_decode_fast_path_matches_builtin_scan():
+    """The layer-level wiring: a stateful single-timestep GravesLSTM
+    forward (the decode engine's / rnn_time_step's shape) routed through
+    the lstm_decode_step helper equals the built-in scan path. Two
+    fresh same-seed nets so each traces its own jit cache with the
+    helper in a different state."""
+    from deeplearning4j_tpu.models.charlstm import char_lstm_network
+    from deeplearning4j_tpu.ops.helpers import set_helper_enabled
+
+    vocab = 9
+    x = np.zeros((2, vocab), np.float32)
+    x[0, 3] = 1.0
+    x[1, 5] = 1.0
+    net_on = char_lstm_network(vocab_size=vocab, hidden=16, layers=1,
+                               tbptt_length=8)
+    net_off = char_lstm_network(vocab_size=vocab, hidden=16, layers=1,
+                                tbptt_length=8)
+    set_helper_enabled("lstm_decode_step", True)
+    out_on = np.asarray(net_on.rnn_time_step(x))
+    out_on2 = np.asarray(net_on.rnn_time_step(x))  # carried state step
+    set_helper_enabled("lstm_decode_step", False)
+    try:
+        out_off = np.asarray(net_off.rnn_time_step(x))
+        out_off2 = np.asarray(net_off.rnn_time_step(x))
+    finally:
+        set_helper_enabled("lstm_decode_step", True)
+    np.testing.assert_allclose(out_on, out_off, atol=1e-6)
+    np.testing.assert_allclose(out_on2, out_off2, atol=1e-6)
