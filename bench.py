@@ -952,6 +952,53 @@ def bench_decode(n_slots=8, duration=6.0, vocab=32, hidden=64,
                 out = np.asarray(net.rnn_time_step(oh))
         return n_tok / (time.perf_counter() - t0)
 
+    # -- fused decode steps: K steps scanned into ONE jitted dispatch --------
+    def fused_probe(k, n_reqs=16):
+        """Mini-soak at fused_steps=k on a fresh engine (own metric
+        prefix — the books above must not be polluted): a FIXED request
+        set, client-side ITL tracking, tokens/sec from the engine's own
+        counters. K=1 is the per-step dispatch baseline; the K>1 arm
+        shows what amortizing host dispatch overhead buys."""
+        eng = DecodeEngine(net, n_slots=n_slots,
+                           tenant_weights={"gold": 3.0, "std": 1.0},
+                           default_max_tokens=32, queue_capacity=256,
+                           component_prefix=f"bench_decode_f{k}")
+        try:
+            eng.set_fused_steps(k)
+            eng.generate([1, 2, 3], max_new_tokens=2,
+                         tenant="gold").result(120)
+            tr = LatencyTracker(window=50_000)
+            last = {}
+
+            def mk_cb(i):
+                def cb(_tok):
+                    now = time.perf_counter()
+                    if i in last:
+                        tr.record(now - last[i])
+                    last[i] = now
+                return cb
+
+            reqs = [make_req(50_000 + i) for i in range(n_reqs)]
+            tok0 = eng.metrics()["tokens"]
+            tp0 = time.perf_counter()
+            futs = [eng.generate(p, max_new_tokens=nn, tenant=ten,
+                                 on_token=mk_cb(i))
+                    for i, (p, nn, ten) in enumerate(reqs)]
+            for f in futs:
+                f.result(timeout=120)
+            dtp = time.perf_counter() - tp0
+            n_tok = eng.metrics()["tokens"] - tok0
+        finally:
+            eng.shutdown()
+        snap = tr.snapshot()
+        return {"tokens_per_sec": round(n_tok / dtp, 1),
+                "itl_p50_ms": snap["p50_ms"],
+                "itl_p99_ms": snap["p99_ms"]}
+
+    fused_k = 4
+    f_base = fused_probe(1)
+    f_fused = fused_probe(fused_k)
+
     naive_tps = naive_tokens_per_sec()
     engine_tps = tokens / dt
     return {
@@ -980,6 +1027,19 @@ def bench_decode(n_slots=8, duration=6.0, vocab=32, hidden=64,
             "swaps_counted": after["swaps"] - before["swaps"],
         },
         "zero_retraces": bool(final_cache == warm_cache),
+        # K decode steps per dispatch (serving/decode.set_fused_steps):
+        # same fixed request set both arms, fresh engine each
+        "fused_steps": {
+            "k": fused_k,
+            "tokens_per_sec": f_fused["tokens_per_sec"],
+            "itl_p50_ms": f_fused["itl_p50_ms"],
+            "itl_p99_ms": f_fused["itl_p99_ms"],
+            "unfused_tokens_per_sec": f_base["tokens_per_sec"],
+            "unfused_itl_p50_ms": f_base["itl_p50_ms"],
+            "unfused_itl_p99_ms": f_base["itl_p99_ms"],
+            "speedup": round(f_fused["tokens_per_sec"]
+                             / max(f_base["tokens_per_sec"], 1e-9), 2),
+        },
         "books": {k: after[k] for k in ("admitted", "completed", "shed",
                                         "failed", "rejected")},
         "tenants": after["tenants"],
@@ -1250,9 +1310,17 @@ def _bench_multichip(workload: str):
 
     reg = get_registry()
 
-    def timed_sharded():
+    def timed_sharded(bucket_bytes=None, grad_dtype=None, block_scan=None):
+        """One sharded arm under explicit collective knobs. Reports the
+        throughput AND the per-arm evidence: allreduce wire-byte delta,
+        the chosen bucket schedule, `graph_block` body-trace count and
+        the first dispatch's trace+compile wall time (where the
+        scan-over-blocks collapse shows up)."""
         mesh = data_parallel_mesh()
-        net = make_net().set_mesh(mesh)
+        net = make_net().set_mesh(mesh, bucket_bytes=bucket_bytes,
+                                  grad_dtype=grad_dtype)
+        if block_scan is not None and hasattr(net, "set_block_scan"):
+            net.set_block_scan(block_scan)
         if per_chip_flops:
             net.set_model_flops_per_example(step_flops / gb, flops_source)
         plan = net._mesh_plan
@@ -1266,17 +1334,38 @@ def _bench_multichip(workload: str):
             "fit_data_wait_seconds",
             "time blocked on the data iterator (ETL) before a "
             "dispatch").labels()
-        c0, s0 = wait.count, wait.sum
-        dt, n_steps = _time_fit(
-            net, lambda k: ExistingDataSetIterator([staged] * k), steps,
-            reps=3 if on_tpu else 1)
-        wait_ms = ((wait.sum - s0) / max(1, wait.count - c0)) * 1e3
+        gb_notes = reg.counter(
+            "compile_total", "jit cache insertions (fresh traces)",
+            ("kind",)).labels("graph_block")
         ar = reg.counter(
             "allreduce_bytes_total",
             "gradient bytes all-reduced in-graph by the sharded "
             "train step (logical payload: summed gradient leaf "
             "bytes per optimizer step)").labels()
-        return dt, n_steps, wait_ms, int(ar.value)
+        c0, s0, ar0, gb0 = wait.count, wait.sum, ar.value, gb_notes.value
+        # first fit = trace + compile + one step: the compile-collapse
+        # measurement (latency-cancelled throughput timing comes after)
+        t0 = time.perf_counter()
+        net.fit(ExistingDataSetIterator([staged]), epochs=1,
+                async_prefetch=False)
+        _sync(net)
+        first_s = time.perf_counter() - t0
+        dt, n_steps = _time_fit(
+            net, lambda k: ExistingDataSetIterator([staged] * k), steps,
+            reps=3 if on_tpu else 1)
+        wait_ms = ((wait.sum - s0) / max(1, wait.count - c0)) * 1e3
+        steps_total = net.iteration
+        return {
+            "dt": dt,
+            "n_steps": n_steps,
+            "wait_ms": wait_ms,
+            "allreduce_bytes": int(ar.value - ar0),
+            "allreduce_bytes_per_step": int(
+                round((ar.value - ar0) / max(1, steps_total))),
+            "graph_block_body_traces": int(gb_notes.value - gb0),
+            "first_dispatch_seconds": round(first_s, 3),
+            "collective": plan.collective_describe(net),
+        }
 
     def timed_single():
         net = make_net()
@@ -1288,7 +1377,26 @@ def _bench_multichip(workload: str):
             reps=3 if on_tpu else 1)
         return dt, n_steps
 
-    sh_dt, sh_steps, sh_wait_ms, allreduce_bytes = timed_sharded()
+    # Three collective arms (the A/B the bucketed path must win or tie):
+    #   bucketed        — headline: default bucket schedule, and on graph
+    #                     nets the scan-over-identical-blocks compile
+    #                     collapse switched on.
+    #   monolithic      — bucket_bytes=0 (single tail-end all-reduce) with
+    #                     block runs force-unrolled: the old mainline.
+    #   bucketed_bf16   — bucketed schedule + opt-in bf16 wire payload
+    #                     (f32 accumulate): halves allreduce bytes.
+    # (block_scan is hasattr-gated inside timed_sharded: MultiLayerNetwork
+    # has no graph topology to scan, so the knob is a no-op there.)
+    # Monolithic runs FIRST: the first arm absorbs one-time process
+    # warmup (allocator growth, op registries) into its
+    # first_dispatch_seconds, and charging that to the headline arm
+    # would fake a compile-collapse regression — or hide a real one.
+    arm_mono = timed_sharded(bucket_bytes=0, block_scan="unroll")
+    arm_bucketed = timed_sharded(block_scan=True)
+    arm_bf16 = timed_sharded(grad_dtype="bf16", block_scan=True)
+    sh_dt, sh_steps = arm_bucketed["dt"], arm_bucketed["n_steps"]
+    sh_wait_ms = arm_bucketed["wait_ms"]
+    allreduce_bytes = arm_bucketed["allreduce_bytes"]
     si_dt, si_steps = timed_single()
 
     # legacy arm: per-shard device-resident batches, host averaging
@@ -1305,11 +1413,31 @@ def _bench_multichip(workload: str):
         avg_dt, vs_alt_err = None, f"{type(e).__name__}: {e}"
 
     # per-chip throughput: the sharded arm consumed gb examples/step
-    sharded_per_chip = per_step_examples / n * sh_steps / sh_dt
+    def per_chip_rate(arm):
+        return per_step_examples / n * arm["n_steps"] / arm["dt"]
+
+    def arm_summary(arm):
+        return {
+            "value": round(per_chip_rate(arm), 2),
+            "allreduce_bytes": arm["allreduce_bytes"],
+            "allreduce_bytes_per_step": arm["allreduce_bytes_per_step"],
+            "graph_block_body_traces": arm["graph_block_body_traces"],
+            "first_dispatch_seconds": arm["first_dispatch_seconds"],
+            "collective": arm["collective"],
+        }
+
+    sharded_per_chip = per_chip_rate(arm_bucketed)
     single_chip = per_step_examples / n * si_steps / si_dt
     efficiency = sharded_per_chip / single_chip if single_chip else None
     mfu = (per_chip_flops * sh_steps / sh_dt / peak_flops_per_chip()
            if on_tpu and per_chip_flops else None)
+    vs_alt = {
+        "collective_monolithic": round(per_chip_rate(arm_mono), 2),
+        "collective_bucketed_bf16": round(per_chip_rate(arm_bf16), 2),
+    }
+    if avg_dt is not None:
+        vs_alt["param_averaging_host"] = round(
+            per_step_examples / n * steps / avg_dt, 2)
     out = {
         "value": round(sharded_per_chip, 2),
         "unit": unit,
@@ -1321,18 +1449,27 @@ def _bench_multichip(workload: str):
         "scaling_efficiency": (None if efficiency is None
                                else round(efficiency, 3)),
         "kernel": "sharded_step_allreduce",
-        "vs_alternate": {} if avg_dt is None else {
-            "param_averaging_host": round(
-                per_step_examples / n * steps / avg_dt, 2)},
+        "vs_alternate": vs_alt,
         **({"vs_alternate_errors": {"param_averaging_host": vs_alt_err}}
            if vs_alt_err else {}),
+        # the three-arm collective A/B: bucketed is the headline arm
+        # above; the per-arm evidence (wire bytes, bucket schedule,
+        # graph_block trace counts, first-dispatch trace+compile wall)
+        # is what makes the bucketed/bf16/scan claims falsifiable
+        "collective_ab": {
+            "bucketed": arm_summary(arm_bucketed),
+            "monolithic": arm_summary(arm_mono),
+            "bucketed_bf16": arm_summary(arm_bf16),
+        },
         "fit_data_wait_mean_ms": round(sh_wait_ms, 3),
         "allreduce_bytes_total": allreduce_bytes,
         "model_flops_per_step": step_flops,
         "model_flops_per_chip": per_chip_flops,
         "flops_source": flops_source,
         "mfu": None if mfu is None else round(mfu, 4),
-        "seconds": round(sh_dt + si_dt + (avg_dt or 0.0), 3),
+        "seconds": round(
+            arm_bucketed["dt"] + arm_mono["dt"] + arm_bf16["dt"]
+            + si_dt + (avg_dt or 0.0), 3),
     }
     return out
 
@@ -1491,6 +1628,91 @@ def _vs_baseline(workloads, backend):
     return result
 
 
+def _prior_multichip():
+    """Newest committed MULTICHIP_r*.json next to this file — the
+    multi-chip trajectory's previous point. Same tolerance as
+    _prior_bench: driver-wrapped ({"tail": ...}) or bare result JSON.
+    Returns (basename, result) or (None, None)."""
+    import glob
+    import re
+
+    def round_no(p):
+        m = re.search(r"MULTICHIP_r(\d+)\.json$", p)
+        return int(m.group(1)) if m else -1
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    for path in sorted(glob.glob(os.path.join(here, "MULTICHIP_r*.json")),
+                       key=round_no, reverse=True):
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        if not isinstance(doc, dict):
+            continue
+        if "workloads" in doc:
+            return os.path.basename(path), doc
+        for line in reversed(str(doc.get("tail", "")).strip().splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    result = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if "workloads" in result:
+                    return os.path.basename(path), result
+    return None, None
+
+
+def _vs_multichip_baseline(workloads, backend, devices):
+    """Multi-chip analogue of _vs_baseline: the comparable number across
+    MULTICHIP rounds is `scaling_efficiency` (a within-round ratio, so it
+    survives box-speed noise that raw img/s does not); the raw per-chip
+    `value` ratio rides along as secondary evidence. Ratios only against
+    a prior round on the SAME backend and device count, with the same
+    FLOP-drift tripwire as the kernel benches."""
+    prior_name, prior = _prior_multichip()
+    if not prior:
+        return None
+    prior_backend = prior.get("backend")
+    prior_devices = prior.get("devices")
+    if backend != prior_backend or devices != prior_devices:
+        return {"source": prior_name,
+                "note": f"setup mismatch ({backend}/{devices}dev vs prior "
+                        f"{prior_backend}/{prior_devices}dev): "
+                        "ratios omitted"}
+    eff_ratios, val_ratios, flop_drift = {}, {}, {}
+    for name, out in workloads.items():
+        prior_wl = (prior.get("workloads") or {}).get(name) or {}
+        pe, ce = prior_wl.get("scaling_efficiency"), out.get(
+            "scaling_efficiency")
+        if pe and ce:
+            eff_ratios[name] = round(ce / pe, 3)
+        pv, cv = prior_wl.get("value"), out.get("value")
+        if pv and cv:
+            val_ratios[name] = round(cv / pv, 3)
+        pf = prior_wl.get("model_flops_per_step")
+        cf = out.get("model_flops_per_step")
+        if pf and cf and abs(cf / pf - 1.0) > 0.01:
+            flop_drift[name] = {
+                "prior": pf, "current": cf, "ratio": round(cf / pf, 4),
+                "prior_source": prior_wl.get("flops_source", "analytic"),
+                "current_source": out.get("flops_source"),
+            }
+    result = {
+        "source": prior_name,
+        "headline": eff_ratios.get("resnet50"),
+        "efficiency_ratio": eff_ratios,
+        "value_ratio": val_ratios,
+    }
+    if flop_drift:
+        result["flop_model_changed"] = flop_drift
+        result["flop_model_note"] = (
+            "model_flops_per_step differs from the prior round for these "
+            "workloads — an accounting change, never a speedup")
+    return result
+
+
 def _probe():
     """Child mode: prove the device path is alive. Tiny matmul + scalar
     readback (block_until_ready does not block through the tunnel)."""
@@ -1539,7 +1761,10 @@ def main_multichip(devices=None):
         extra["XLA_FLAGS"] = " ".join(flags)
     workloads, errors = {}, {}
     for name in ("resnet50", "char_lstm"):
-        out, err = _run_child(["--workload-multichip", name], 900,
+        # 1500s: the three-arm collective A/B compiles three distinct
+        # SPMD programs per workload; on a 1-core box forcing 8 virtual
+        # devices the resnet50 child alone measures ~800s
+        out, err = _run_child(["--workload-multichip", name], 1500,
                               extra_env=extra)
         if out is not None:
             child_backend = out.pop("backend", None)
@@ -1568,6 +1793,9 @@ def main_multichip(devices=None):
                  else None),
         "workloads": workloads,
     }
+    vs = _vs_multichip_baseline(workloads, backend, result["devices"])
+    if vs is not None:
+        result["vs_baseline"] = vs
     if errors:
         result["errors"] = errors
     print(json.dumps(result))

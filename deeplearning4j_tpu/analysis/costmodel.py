@@ -294,6 +294,13 @@ class CostModel:
     # single-device nets): the traced program is the GLOBAL step, so
     # every per-chip view divides batch-sharded quantities by this
     data_axis_shards: int = 1
+    # the priced gradient-collective schedule (parallel/sharded
+    # CollectivePlan.describe via the net's MeshPlan): wire bytes per
+    # step at the configured grad dtype, bucket sizes, and the ring
+    # all-reduce time estimate. None for single-device nets. Priced
+    # SEPARATELY from the FLOP families — attaching it must never move
+    # model_flops (JX007 guards that)
+    collective: Optional[dict] = None
 
     @property
     def flops_total(self) -> float:
@@ -351,7 +358,7 @@ class CostModel:
         t_compute = self.flops_total / n / peak
         t_memory = self.bytes_total / n / bw
         bound = max(t_compute, t_memory, 1e-30)
-        return {
+        out = {
             "peak_flops": peak,
             "hbm_bandwidth": bw,
             "ridge_intensity": peak / bw,
@@ -361,6 +368,16 @@ class CostModel:
             "step_time_lower_bound_seconds": bound,
             "mfu_ceiling": self.model_flops_per_chip / (peak * bound),
         }
+        if self.collective is not None:
+            # the gradient all-reduce rides along unpriced in the bound:
+            # the bucketed schedule exists to OVERLAP it with compute, so
+            # the honest statement is "hidden if collective <= bound" —
+            # reported, never silently added to the lower bound
+            t_coll = self.collective.get("ring_estimate_seconds")
+            out["collective_seconds"] = t_coll
+            if t_coll is not None:
+                out["collective_hidden_by_compute"] = bool(t_coll <= bound)
+        return out
 
     def table(self, peak_flops: Optional[float] = None,
               hbm_bandwidth: Optional[float] = None) -> List[dict]:
@@ -404,6 +421,7 @@ class CostModel:
             "data_axis_shards": self.data_axis_shards,
             "model_flops_per_chip": self.model_flops_per_chip,
             "resident_bytes": self.resident_bytes,
+            "collective": self.collective,
             "families": {k: v.to_dict() for k, v in self.families.items()},
         }
 
@@ -508,6 +526,10 @@ def _model_of_step(net, step, args, batch_size: int) -> CostModel:
     plan = getattr(net, "_mesh_plan", None)
     if plan is not None:
         cm.data_axis_shards = max(1, int(plan.n_data_shards))
+        try:
+            cm.collective = plan.collective_describe(net)
+        except Exception:
+            cm.collective = None  # pricing must never sink the model
     return cm
 
 

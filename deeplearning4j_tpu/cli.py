@@ -976,6 +976,28 @@ def cmd_doctor(args) -> int:
         net.set_mesh(data_parallel_mesh(avail[:devices]))
         print(f"doctor: auditing the sharded train step over "
               f"{net._mesh_plan.describe()}")
+        # surface the chosen gradient-collective schedule next to the
+        # donation audit: bucket count/sizes, wire dtype and bytes per
+        # step, ring-time estimate — the knobs set_mesh(bucket_bytes=,
+        # grad_dtype=) control
+        try:
+            coll = net._mesh_plan.collective_describe(net)
+        except Exception as e:
+            print(f"doctor: collective schedule unavailable "
+                  f"({type(e).__name__}: {e})")
+        else:
+            sizes = coll.get("bucket_sizes_bytes")
+            sched = ("monolithic (single tail-end all-reduce)"
+                     if coll["mode"] == "monolithic" else
+                     f"{coll['n_buckets']} bucket(s) "
+                     f"{[f'{b / 2**20:.2f}MiB' for b in sizes]} "
+                     f"(bucket_bytes={coll['bucket_bytes']}, "
+                     f"{coll['bucketed_leaves']} leaves bucketed, "
+                     f"{coll['unbucketed_leaves']} unbucketed)")
+            print(f"doctor: gradient collective: {sched}; wire dtype "
+                  f"{coll['grad_dtype']}, "
+                  f"{coll['wire_bytes_per_step']} bytes/step, ring "
+                  f"estimate {coll['ring_estimate_seconds']:.2e}s")
     findings = net.doctor(batch_size=args.batch, timesteps=args.timesteps,
                           jaxpr=not args.no_jaxpr)
     if args.json == "-":

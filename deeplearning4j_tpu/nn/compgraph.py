@@ -58,6 +58,152 @@ from deeplearning4j_tpu.train.updaters import (
 )
 
 
+# -- scan-over-identical-blocks ----------------------------------------------
+#
+# Deep nets built from repeated identical units (ResNet stage blocks) pay
+# trace+compile cost proportional to depth: every unit is re-traced even
+# though its program is the same. Detecting maximal runs of
+# identically-configured, chain-connected units and compiling each run as
+# ONE `lax.scan` over stacked per-unit params collapses that cost to one
+# unit body per run — `compile_total{kind="graph_block"}` records k body
+# traces unrolled vs 1 scanned. Opt-in via set_block_scan / DL4J_BLOCK_SCAN
+# (forward numerics are unchanged; see the block-scan tests in
+# tests/test_compgraph.py).
+
+def _vertex_signature(v):
+    """Structural identity of a vertex conf: (type, canonical-JSON config),
+    or None when the vertex cannot participate in a scanned run."""
+    from deeplearning4j_tpu.nn.conf.graph import (
+        ElementWiseVertex,
+        MergeVertex,
+        ScaleVertex,
+        ShiftVertex,
+    )
+    from deeplearning4j_tpu.nn.conf.serde import config_to_dict
+    import json as _json
+
+    if isinstance(v, LayerVertex):
+        lc = v.layer
+        if v.preprocessor is not None:
+            return None
+        if _is_recurrent(lc) or isinstance(lc, _OUTPUT_LAYER_TYPES):
+            return None
+        body = lc
+    elif isinstance(v, (ElementWiseVertex, MergeVertex, ScaleVertex,
+                        ShiftVertex)):
+        body = v
+    else:
+        return None
+    try:
+        return (type(v).__name__,
+                _json.dumps(config_to_dict(body), sort_keys=True))
+    except Exception:
+        return None
+
+
+def _detect_block_runs(conf, topo, pidx_map):
+    """Find maximal runs of >=2 consecutive identical units in the topo
+    order. A unit of period p starting at topo index s repeats at s+p,
+    s+2p, ... when each repeated vertex has the same signature and the
+    same *relative* input offsets, every offset d at local position q is
+    internal (d <= q) or the previous unit's exit (d == q+1), no vertex
+    but the run's exit is consumed outside the run, and each unit holds
+    at least one layer. Returns run records consumed by _exec_block_run."""
+    index = {n: i for i, n in enumerate(topo)}
+    n = len(topo)
+    sigs = [None] * n
+    offsets = [None] * n
+    for i, name in enumerate(topo):
+        v = conf.vertices.get(name)
+        if v is None:  # a graph input
+            continue
+        sigs[i] = _vertex_signature(v)
+        offsets[i] = tuple(i - index[src] for src in conf.vertex_inputs[name])
+
+    consumers = {}
+    for name, ins in conf.vertex_inputs.items():
+        for src in ins:
+            consumers.setdefault(src, []).append(name)
+
+    def unit_ok(s, p):
+        """Template unit [s, s+p): signable, chain-connected."""
+        for q in range(p):
+            i = s + q
+            if sigs[i] is None or offsets[i] is None:
+                return False
+            for d in offsets[i]:
+                if not (1 <= d <= q + 1):
+                    return False
+        return any(
+            isinstance(conf.vertices[topo[s + q]], LayerVertex)
+            for q in range(p)
+        )
+
+    def repeats(s, p):
+        k = 1
+        while s + (k + 1) * p <= n:
+            base = s + k * p
+            if all(
+                sigs[base + q] == sigs[s + q]
+                and offsets[base + q] == offsets[s + q]
+                for q in range(p)
+            ):
+                k += 1
+            else:
+                break
+        return k
+
+    def run_ok(s, p, k):
+        lo, hi = s, s + p * k
+        exit_name = topo[hi - 1]
+        for i in range(lo, hi - 1):
+            name = topo[i]
+            if name in conf.outputs:
+                return False
+            for c in consumers.get(name, ()):
+                if not (lo <= index[c] < hi):
+                    return False
+        return exit_name is not None
+
+    runs = []
+    i = len(conf.inputs)
+    while i < n:
+        found = None
+        for p in range(1, (n - i) // 2 + 1):
+            if not unit_ok(i, p):
+                continue
+            k = repeats(i, p)
+            if k >= 2 and run_ok(i, p, k):
+                found = (p, k)
+                break  # smallest period = most units collapsed
+        if found is None:
+            i += 1
+            continue
+        p, k = found
+        unit_names = topo[i:i + p]
+        layer_slots = [
+            q for q in range(p)
+            if isinstance(conf.vertices[unit_names[q]], LayerVertex)
+        ]
+        pidx_rows = [
+            [pidx_map[topo[i + u * p + q]] for q in layer_slots]
+            for u in range(k)
+        ]
+        runs.append({
+            "start": i,
+            "period": p,
+            "count": k,
+            "entry": topo[i - 1],
+            "exit": topo[i + p * k - 1],
+            "unit_names": unit_names,
+            "offsets": [offsets[i + q] for q in range(p)],
+            "layer_slots": layer_slots,
+            "pidx_rows": pidx_rows,
+        })
+        i += p * k
+    return runs
+
+
 def _as_multidataset(ds) -> MultiDataSet:
     if isinstance(ds, MultiDataSet):
         return ds
@@ -96,9 +242,47 @@ class ComputationGraph(NetworkBase):
         ]
         self._train_step_fn = None
         self._output_fn = None
+        self._block_scan = None  # None = DL4J_BLOCK_SCAN env decides
+        self._block_runs_cache = None
 
     def _ordered_layer_confs(self):
         return self._layer_confs
+
+    # -- scan-over-identical-blocks ------------------------------------------
+
+    def set_block_scan(self, mode=True) -> "ComputationGraph":
+        """Compile runs of identically-configured residual blocks as ONE
+        scanned body with stacked params instead of tracing every block
+        (True/"scan" on, False/"unroll" off, None = DL4J_BLOCK_SCAN env).
+        Collapses `compile_total{kind="graph_block"}` and trace time on
+        deep nets (ResNet-50 stage blocks); forward numerics unchanged.
+        Note: feed_forward() then reports only each run's exit activation
+        — per-block intermediates live inside the scan."""
+        if mode not in (True, False, None, "scan", "unroll"):
+            raise ValueError(
+                f"set_block_scan: expected True/'scan', False/'unroll' or "
+                f"None, got {mode!r}")
+        self._block_scan = mode
+        self._block_runs_cache = None
+        self._reset_step_programs()
+        return self
+
+    def _block_scan_enabled(self) -> bool:
+        mode = self._block_scan
+        if mode is None:
+            import os as _os
+
+            mode = _os.environ.get("DL4J_BLOCK_SCAN", "0")
+        return mode in (True, "1", "scan", "on")
+
+    def _block_runs(self):
+        """Detected identical-unit runs (cached; detection is pure conf
+        analysis, so it is computed even with the scan off — the unrolled
+        path uses it to count `graph_block` body traces honestly)."""
+        if self._block_runs_cache is None:
+            self._block_runs_cache = _detect_block_runs(
+                self.conf, self.topo, self._pidx)
+        return self._block_runs_cache
 
     # -- init ----------------------------------------------------------------
 
@@ -139,9 +323,39 @@ class ComputationGraph(NetworkBase):
         sole_mask = next(iter(masks.values())) if len(masks) == 1 else None
         new_states: List[Optional[dict]] = [None] * len(self.layer_vertex_names)
         env = {"activations": acts, "input_masks": masks}
-        for name in self.topo:
+        scan_on = self._block_scan_enabled()
+        run_by_start = {r["start"]: r for r in self._block_runs()}
+        topo = self.topo
+        pos = 0
+        while pos < len(topo):
+            name = topo[pos]
             if name in acts:
+                pos += 1
                 continue
+            r = run_by_start.get(pos)
+            if r is not None:
+                x_entry = acts[r["entry"]]
+                tracing = isinstance(x_entry, jax.core.Tracer)
+                out = None
+                if scan_on and self._run_shapes_ok(r, params, states):
+                    out = self._exec_block_run(
+                        r, params, states, x_entry,
+                        training=training, rng=rng, sole_mask=sole_mask)
+                if out is not None:
+                    exit_act, st_updates = out
+                    acts[r["exit"]] = exit_act
+                    for pidx, ns in st_updates.items():
+                        new_states[pidx] = ns
+                    if tracing:
+                        self._note_compile("graph_block", r["exit"])
+                    pos = r["start"] + r["period"] * r["count"]
+                    continue
+                if tracing:
+                    # unrolled: every unit's body is traced separately —
+                    # count each so compile_total{kind="graph_block"}
+                    # shows the collapse when the scan is on
+                    for _ in range(r["count"]):
+                        self._note_compile("graph_block", r["exit"])
             v = conf.vertices[name]
             xs = [acts[i] for i in conf.vertex_inputs[name]]
             if isinstance(v, LayerVertex):
@@ -180,7 +394,114 @@ class ComputationGraph(NetworkBase):
                 acts[name] = x
             else:
                 acts[name] = v.forward(xs, env)
+            pos += 1
         return acts, new_states
+
+    def _run_shapes_ok(self, r, params, states) -> bool:
+        """True when every unit's params/state trees share structure and
+        leaf shapes — the precondition for stacking them (cached on the
+        run record; shapes are fixed after init)."""
+        cached = r.get("_shapes_ok")
+        if cached is not None:
+            return cached
+
+        def sig(tree):
+            leaves, treedef = jax.tree_util.tree_flatten(tree)
+            return (str(treedef),
+                    tuple((tuple(l.shape), str(l.dtype)) for l in leaves))
+
+        ok = True
+        rows = r["pidx_rows"]
+        for j in range(len(r["layer_slots"])):
+            base = (sig(params[rows[0][j]]), sig(states[rows[0][j]]))
+            for row in rows[1:]:
+                if (sig(params[row[j]]), sig(states[row[j]])) != base:
+                    ok = False
+        r["_shapes_ok"] = ok
+        return ok
+
+    def _exec_block_run(self, r, params, states, x, *, training, rng,
+                        sole_mask):
+        """Run one detected identical-unit run as a single `lax.scan`:
+        per-unit params/states stacked in-graph (leading unit axis), the
+        unit body replicating the per-vertex walk with run-local
+        activations, the entry activation as carry. Per-layer rng keys
+        fold in the REAL pidx (fed as scan xs), so dropout draws match
+        the unrolled walk. Returns (exit activation, {pidx: new_state})
+        or None when the unit is not shape-invariant (strided/shrinking
+        units cannot be a scan carry) — caller falls back to unrolling."""
+        conf = self.conf
+        p, k = r["period"], r["count"]
+        slots = r["layer_slots"]
+        rows = r["pidx_rows"]
+        unit_names = r["unit_names"]
+        offsets = r["offsets"]
+        slot_of = {q: j for j, q in enumerate(slots)}
+
+        stack = lambda trees: jax.tree_util.tree_map(
+            lambda *a: jnp.stack(a), *trees)
+        sp = tuple(stack([params[row[j]] for row in rows])
+                   for j in range(len(slots)))
+        ss = tuple(stack([states[row[j]] for row in rows])
+                   for j in range(len(slots)))
+        pmat = jnp.asarray(rows, jnp.int32)  # [k, n_slots]
+
+        def run_unit(carry, up, us, prow):
+            local: Dict[int, jnp.ndarray] = {}
+            new_sts = []
+            for q, vname in enumerate(unit_names):
+                v = conf.vertices[vname]
+                srcs = [carry if d == q + 1 else local[q - d]
+                        for d in offsets[q]]
+                if isinstance(v, LayerVertex):
+                    xq = srcs[0]
+                    j = slot_of[q]
+                    ctx = LayerContext(
+                        training=training,
+                        rng=(jax.random.fold_in(rng, prow[j])
+                             if rng is not None else None),
+                        mask=sole_mask if xq.ndim == 3 else None,
+                        timesteps=xq.shape[1] if xq.ndim == 3 else None,
+                        state=us[j],
+                    )
+                    y, ns = forward_layer(v.layer, up[j], xq, ctx)
+                    new_sts.append(ns)
+                else:
+                    y = v.forward(srcs, {})
+                local[q] = y
+            return local[p - 1], tuple(new_sts)
+
+        # scan-carry contract: one abstract unit application must preserve
+        # the entry activation's shape/dtype (a strided unit would not)
+        try:
+            probe = jax.eval_shape(
+                lambda a: run_unit(
+                    a,
+                    tuple(params[i] for i in rows[0]),
+                    tuple(states[i] for i in rows[0]),
+                    pmat[0],
+                )[0],
+                x,
+            )
+        except Exception:
+            return None
+        if probe.shape != x.shape or probe.dtype != x.dtype:
+            return None
+
+        def body(carry, xs_scan):
+            up, us, prow = xs_scan
+            return run_unit(carry, up, us, prow)
+
+        exit_act, ys = jax.lax.scan(body, x, (sp, ss, pmat))
+        updates = {}
+        for j in range(len(slots)):
+            nsj = ys[j]
+            if nsj is None:
+                continue
+            for u in range(k):
+                updates[rows[u][j]] = jax.tree_util.tree_map(
+                    lambda a, u=u: a[u], nsj)
+        return exit_act, updates
 
     def _merge_states(self, old, new):
         return [n if n is not None else o for o, n in zip(old, new)]
@@ -347,10 +668,10 @@ class ComputationGraph(NetworkBase):
         tmask = self._trainable_mask()
         updater = self.updater_def
         minimize = self.net_conf.minimize
-        # in-graph gradient all-reduce under a mesh plan — same pinning
-        # as MultiLayerNetwork._make_step_body (see the comment there)
+        # in-graph bucketed gradient all-reduce under a mesh plan — same
+        # emission as MultiLayerNetwork._make_step_body (see the comment
+        # there; the schedule lives in parallel/sharded.CollectivePlan)
         plan = self._mesh_plan
-        gshard = None if plan is None else plan.grad_shardings(self)
 
         def step(params, states, upd_state, data, lr, t, rng):
             def loss_fn(p):
@@ -359,8 +680,8 @@ class ComputationGraph(NetworkBase):
             (score, new_states), grads = jax.value_and_grad(
                 loss_fn, has_aux=True
             )(params)
-            if gshard is not None:
-                grads = jax.lax.with_sharding_constraint(grads, gshard)
+            if plan is not None:
+                grads = plan.reduce_grads(self, grads)
             # global grad norm of the RAW gradient (before masking/
             # clipping), accumulated in f32 — the sentinel diagnostic
             gsq = jnp.float32(0.0)

@@ -303,9 +303,11 @@ class MultiLayerNetwork(NetworkBase):
         # constraining the grads to the parameter shardings makes GSPMD
         # insert the cross-device psum/mean at the grad site (replicated
         # params x data-sharded batch), replacing the reference's
-        # host-side parameter averaging
+        # host-side parameter averaging. The plan emits it BUCKETED
+        # (reverse-topo flat payloads, parallel/sharded.CollectivePlan):
+        # each bucket's collective depends only on its own leaves, so the
+        # scheduler can overlap early buckets with the remaining backward
         plan = self._mesh_plan
-        gshard = None if plan is None else plan.grad_shardings(self)
 
         def step(params, states, upd_state, data, lr, t, rng):
             def loss_fn(p):
@@ -314,8 +316,8 @@ class MultiLayerNetwork(NetworkBase):
             (score, new_states), grads = jax.value_and_grad(
                 loss_fn, has_aux=True
             )(params)
-            if gshard is not None:
-                grads = jax.lax.with_sharding_constraint(grads, gshard)
+            if plan is not None:
+                grads = plan.reduce_grads(self, grads)
             # global grad norm of the RAW gradient (before masking/
             # clipping — clipping would hide exactly the explosion the
             # sentinel watches for), accumulated in f32

@@ -212,17 +212,23 @@ class NetworkBase:
             if hasattr(self, attr):
                 setattr(self, attr, None)
 
-    def set_mesh(self, mesh=None, *, plan=None):
+    def set_mesh(self, mesh=None, *, plan=None, bucket_bytes=None,
+                 grad_dtype=None):
         """Attach a device mesh: the mainline multi-chip training path.
         Params/layer state/updater state are committed to the mesh
         replicated (tp/pp placements already on the mesh are honored),
         each fit batch is sharded on the "data" axis by the input
         pipeline, and the optimizer step compiles to ONE donated SPMD
-        program with the gradient all-reduce in-graph (see
-        parallel/sharded.py). `mesh=None` builds a 1-D "data" mesh over
-        all visible devices; `plan` overrides the MeshPlan (the
-        multi-host DCN plan does). `fit()` calls this automatically when
-        more than one device is visible (DL4J_AUTO_MESH=0 disables)."""
+        program with the gradient all-reduce in-graph — bucketed per the
+        plan's CollectivePlan (see parallel/sharded.py). `mesh=None`
+        builds a 1-D "data" mesh over all visible devices; `plan`
+        overrides the MeshPlan (the multi-host DCN plan does).
+        `bucket_bytes` sets the gradient-bucket size (0 = monolithic
+        tail-end reduction; default DL4J_GRAD_BUCKET_BYTES or 4 MiB);
+        `grad_dtype="bf16"` opts the all-reduce wire payload into bf16
+        (f32 accumulation after the reduce — never the default). `fit()`
+        calls this automatically when more than one device is visible
+        (DL4J_AUTO_MESH=0 disables)."""
         from deeplearning4j_tpu.parallel.sharded import MeshPlan
 
         self._require_init()
@@ -231,7 +237,12 @@ class NetworkBase:
 
             mesh = data_parallel_mesh()
         if plan is None:
-            plan = MeshPlan(mesh)
+            plan = MeshPlan(mesh, bucket_bytes=bucket_bytes,
+                            grad_dtype=grad_dtype)
+        elif bucket_bytes is not None or grad_dtype is not None:
+            raise ValueError(
+                "bucket_bytes/grad_dtype are MeshPlan knobs — pass them "
+                "to the plan's constructor, not alongside plan=")
         plan.place_net(self)
         self._mesh_plan = plan
         self._batch_transform = plan.shard_batch
@@ -511,7 +522,14 @@ class NetworkBase:
                     "time attributed to the train step's gradient "
                     "all-reduce, by accounting source (estimate = ring "
                     "wire bytes / ICI bandwidth — a cost model, not a "
-                    "measurement)", ("source",)).labels("estimate"),
+                    "measurement; measured = sampled blocking dispatch "
+                    "of a reduction-only probe with the live bucket "
+                    "schedule)", ("source",)).labels("estimate"),
+                "collective_seconds_measured": reg.counter(
+                    "train_step_collective_seconds",
+                    "time attributed to the train step's gradient "
+                    "all-reduce, by accounting source",
+                    ("source",)).labels("measured"),
                 "recorder": _blackbox.get_recorder(),
                 "devprof": _devprof.get_profiler(),
             }
@@ -581,6 +599,15 @@ class NetworkBase:
             ins["allreduce_bytes"].inc(plan.grad_payload_bytes(self) * n_steps)
             ins["collective_seconds"].inc(
                 plan.collective_seconds_estimate(self) * n_steps)
+            # the estimate's falsifier: every sample_every-th sharded
+            # step, ONE blocking dispatch of the reduction-only probe
+            # (same wire payload + bucket schedule), attributed to the
+            # steps since the last sample — devprof's sampling contract,
+            # so tier-1 (sample_every=0) never blocks here
+            measured = plan.maybe_measure_collective(
+                self, n_steps, ins["devprof"].sample_every)
+            if measured is not None:
+                ins["collective_seconds_measured"].inc(measured)
         # black box + liveness: one ring append (score kept as a device
         # reference — never synced here) and a heartbeat refresh
         ins["recorder"].record_step(self.iteration - 1, score=self._score,

@@ -209,6 +209,8 @@ class DecodeEngine:
         # -- jitted programs (built lazily; O(1) compiles forever) -----------
         self._step_fn = None
         self._reset_fn = None
+        self._fused_steps = 1
+        self._step_k = 1
 
         # -- host scheduling state -------------------------------------------
         self._lock = threading.Lock()
@@ -421,6 +423,26 @@ class DecodeEngine:
             self._wake.notify_all()
         return v
 
+    def set_fused_steps(self, k: int) -> "DecodeEngine":
+        """Scan `k` decode steps into ONE jitted dispatch: the per-slot
+        argmax feeds back in-graph, prompt positions stay teacher-forced
+        (the host precomputes a [k, slots] force mask per window), and
+        the host walks the k returned tokens per slot afterwards —
+        admission and EOS/max-len checks happen every k tokens, deadline
+        checks stay per engine iteration (one window). Cuts per-token
+        dispatch overhead ~k× on dispatch-bound models (see
+        `bench.py decode`'s fused arm); emitted tokens are identical to
+        k=1 because forcing and feedback reproduce the single-step feed
+        exactly. k=1 restores the per-token program."""
+        k = int(k)
+        if k < 1:
+            raise ValueError(f"set_fused_steps needs k >= 1, got {k}")
+        with self._lock:
+            if k != self._fused_steps:
+                self._fused_steps = k
+                self._step_fn = None  # rebuilt lazily at the next step
+        return self
+
     def _swaps_pending_locked(self) -> int:
         return 1 if self._pending_swap is not None else 0
 
@@ -600,8 +622,9 @@ class DecodeEngine:
     def _build_programs(self):
         base = self.model.rnn_decode_step_fn()
         vocab = self.vocab
+        K = self._fused_steps
 
-        def step(params, states, carry, tokens):
+        def one(params, states, carry, tokens):
             # token ids -> exact one-hot rows (bit-identical to the host
             # one-hot a rnn_time_step caller feeds), one step, greedy
             # argmax folded into the same program
@@ -610,7 +633,28 @@ class DecodeEngine:
             return new_carry, jnp.argmax(out, axis=-1).astype(jnp.int32)
 
         donate = (2,) if jax.default_backend() != "cpu" else ()
-        self._step_fn = jax.jit(step, donate_argnums=donate)
+        if K == 1:
+            self._step_fn = jax.jit(one, donate_argnums=donate)
+        else:
+            def fused(params, states, carry, feed_toks, feed_force):
+                # K steps as one scan: teacher-forced positions (prompt
+                # prefill; always step 0, whose token the host staged in
+                # _feed) take feed_toks, the rest feed the previous
+                # argmax back in-graph — the same per-step inputs the
+                # k=1 program sees, so tokens are identical
+                def body(c, xs):
+                    cry, prev = c
+                    ftok, force = xs
+                    tok = jnp.where(force, ftok, prev)
+                    cry, nxt = one(params, states, cry, tok)
+                    return (cry, nxt), nxt
+
+                (carry, _), toks = jax.lax.scan(
+                    body, (carry, feed_toks[0]), (feed_toks, feed_force))
+                return carry, toks  # toks: [K, slots]
+
+            self._step_fn = jax.jit(fused, donate_argnums=donate)
+        self._step_k = K  # the K the live program was built for
         self.model._note_compile("decode_step")
 
         def reset(carry, idx):
@@ -673,13 +717,20 @@ class DecodeEngine:
             # slots shed on the next iteration; an `error` fails the
             # active sequences (their carry is device state mid-flight —
             # not resumable) and the engine keeps serving
+            K = self._step_k
             try:
                 _faults.fault_point("decode_step", active=n_active)
                 with _tracing.span("decode/step", active=n_active,
                                    version=self._version):
-                    self._carry, nxt = self._step_fn(
-                        self._params, self._states, self._carry,
-                        jnp.asarray(self._feed))
+                    if K == 1:
+                        self._carry, nxt = self._step_fn(
+                            self._params, self._states, self._carry,
+                            jnp.asarray(self._feed))
+                    else:
+                        toks, force = self._fused_feed_window(K, active)
+                        self._carry, nxt = self._step_fn(
+                            self._params, self._states, self._carry,
+                            jnp.asarray(toks), jnp.asarray(force))
                     nxt_host = np.asarray(nxt)
             except BaseException as e:
                 self._fail_active(e)
@@ -693,9 +744,91 @@ class DecodeEngine:
         now = time.monotonic()
         t_emit = time.perf_counter()
         for idx, slot in active:
-            self._advance_slot(idx, slot, int(nxt_host[idx]), now, t_emit)
+            if K == 1:
+                self._advance_slot(idx, slot, int(nxt_host[idx]), now,
+                                   t_emit)
+            else:
+                self._advance_slot_fused(idx, slot, nxt_host[:, idx], now,
+                                         t_emit)
         self._hb.beat()
         return True
+
+    def _fused_feed_window(self, K: int, active) -> tuple:
+        """[K, slots] token + force matrices for one fused window: step 0
+        is always forced with the staged `_feed`; later steps force the
+        prompt token a slot will have reached at that step (prefill), and
+        everything else feeds back the in-graph argmax."""
+        toks = np.zeros((K, self.n_slots), np.int32)
+        force = np.zeros((K, self.n_slots), bool)
+        toks[0] = self._feed
+        force[0] = True
+        for idx, slot in active:
+            prompt = slot.req.prompt
+            P = len(prompt)
+            for t in range(1, K):
+                if slot.pos + t < P:
+                    toks[t, idx] = prompt[slot.pos + t]
+                    force[t, idx] = True
+        return toks, force
+
+    def _advance_slot_fused(self, idx: int, slot: _Slot, toks, now: float,
+                            t_emit: float):
+        """Walk one slot through the K tokens of a fused window —
+        the same per-step transitions as _advance_slot (prefill
+        consumes prompt positions, the rest emit), applied K at a time.
+        Tokens computed past EOS/max-len are discarded host-side (the
+        device ran them; the slot's carry resets at its next admission).
+        The per-token latency histogram spreads the window gap evenly
+        over the window's emissions so ITL stays comparable across K."""
+        req = slot.req
+        if req.fut.done():
+            self._free_slot(idx)
+            return
+        P = len(req.prompt)
+        emitted = []
+        done = False
+        for t in range(len(toks)):
+            if slot.pos < P:
+                slot.pos += 1
+                if slot.pos < P:
+                    continue  # still prefilling: this step's output is
+                              # ignored (teacher forcing)
+            token = int(toks[t])
+            req.tokens.append(token)
+            emitted.append(token)
+            if req.on_token is not None:
+                try:
+                    req.on_token(token)
+                except Exception:
+                    logger.exception("decode on_token callback raised "
+                                     "(request continues)")
+            if (len(req.tokens) >= req.max_new_tokens
+                    or (self.eos_token is not None
+                        and token == self.eos_token)):
+                done = True
+                break
+        if emitted:
+            tr = req.ctx.trace_id if req.ctx is not None else None
+            gap = (t_emit - req.last_emit) / len(emitted)
+            for _ in emitted:
+                self._m_token_lat.observe(gap, trace_id=tr)
+            req.last_emit = t_emit
+            self._m_tokens.labels(req.tenant).inc(len(emitted))
+            with self._lock:
+                self._tokens_out += len(emitted)
+        if done:
+            if req.ctx is not None and _tracing.is_enabled():
+                _tracing.record_complete(
+                    "decode/emit", req.t_decode0, time.perf_counter(),
+                    req.ctx, tenant=req.tenant, tokens=len(req.tokens))
+            self._free_slot(idx)
+            self._resolve(req)
+            return
+        # stage the next window's step-0 feed: the next prompt token
+        # while prefilling, else the last emitted token (feedback)
+        self._feed[idx] = (req.prompt[slot.pos] if slot.pos < P
+                           else emitted[-1])
+        self._check_deadline(idx, slot, now)
 
     def _advance_slot(self, idx: int, slot: _Slot, token: int, now: float,
                       t_emit: float):

@@ -31,9 +31,14 @@ bash scripts/lint.sh || exit 1
 # DL4J_AUTO_MESH=1 (the main suite below runs with auto-mesh off so its
 # hundreds of single-device fits don't each compile an 8-way SPMD
 # program). A separate interpreter because the device count is fixed at
-# backend init.
+# backend init. DL4J_GRAD_BUCKET_BYTES=512 forces the smoke nets
+# (~1 KB of grads — far under the 4 MiB default, which would collapse
+# them to one bucket) to split into >1 gradient bucket, so the BUCKETED
+# reduce path is what this smoke exercises, not the degenerate
+# one-bucket schedule.
 rm -f /tmp/_t1_sharding.log
 if timeout -k 10 240 env JAX_PLATFORMS=cpu DL4J_AUTO_MESH=1 \
+    DL4J_GRAD_BUCKET_BYTES=512 \
     XLA_FLAGS="--xla_force_host_platform_device_count=2" \
     python -m pytest tests/test_sharded_step.py -q -m 'not slow' -k smoke \
     -p no:cacheprovider > /tmp/_t1_sharding.log 2>&1; then

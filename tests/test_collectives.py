@@ -1,0 +1,284 @@
+"""Cheap collectives (PR 16): bucketed in-graph gradient all-reduce,
+opt-in bf16 wire payload, and the measured-collective probe.
+
+The contract under test: the BUCKETED f32 reduce is bit-identical to
+the monolithic tail-end all-reduce (same sum, different schedule), the
+bf16 wire halves the all-reduce books while staying inside a pinned
+trajectory tolerance and NEVER becoming the default, and mid-epoch
+resume round-trips through a bucketed mesh. Runs on the virtual
+8-device CPU mesh (tests/conftest.py); the smoke-named test also runs
+in scripts/t1.sh's forced 2-device interpreter, which additionally
+pins DL4J_GRAD_BUCKET_BYTES=512 so even ~1 KB smoke grads split into
+multiple buckets.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.conf import (
+    DenseLayer,
+    NeuralNetConfiguration,
+    OutputLayer,
+    Updater,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.parallel import data_parallel_mesh
+from deeplearning4j_tpu.train.listeners import IterationListener
+from deeplearning4j_tpu.utils.metrics import get_registry
+
+needs_8 = pytest.mark.skipif(
+    len(jax.devices()) < 8,
+    reason="needs the 8-device virtual platform (t1's 2-device smoke "
+           "interpreter runs only the smoke-named tests)")
+
+
+def _mlp_conf(seed=7):
+    return (
+        NeuralNetConfiguration.builder()
+        .seed(seed)
+        .updater(Updater.NESTEROVS)
+        .learning_rate(0.05)
+        .momentum(0.9)
+        .weight_init("xavier")
+        .list()
+        .layer(DenseLayer(n_in=12, n_out=16, activation="tanh"))
+        .layer(OutputLayer(n_in=16, n_out=4, activation="softmax",
+                           loss="mcxent"))
+        .build()
+    )
+
+
+def _data(n=64, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, 12)).astype(np.float32)
+    y = np.zeros((n, 4), np.float32)
+    y[np.arange(n), rng.integers(0, 4, n)] = 1.0
+    return x, y
+
+
+class _ScoreTap(IterationListener):
+    def __init__(self):
+        self.scores = []
+
+    def iteration_done(self, model, iteration, info):
+        self.scores.append(float(np.asarray(info["score"]())))
+
+
+def _sub_mesh(n):
+    devs = jax.devices()
+    assert len(devs) >= n, f"need {n} devices, have {len(devs)}"
+    return data_parallel_mesh(devs[:n])
+
+
+def _fit_sharded(n_dev, *, bucket_bytes, grad_dtype=None, fused_steps=1,
+                 seed=7):
+    net = MultiLayerNetwork(_mlp_conf(seed)).init().set_mesh(
+        _sub_mesh(n_dev), bucket_bytes=bucket_bytes, grad_dtype=grad_dtype)
+    if fused_steps > 1:
+        net.set_fused_steps(fused_steps)
+    tap = _ScoreTap()
+    net.set_listeners(tap)
+    x, y = _data(64, seed=3)
+    net.fit(x, y, batch_size=16, epochs=2, async_prefetch=False)
+    return net, tap
+
+
+def _assert_params_equal(a, b, **tol):
+    for p1, p2 in zip(a.params_list, b.params_list):
+        for k in p1:
+            if tol:
+                np.testing.assert_allclose(
+                    np.asarray(p1[k]), np.asarray(p2[k]), **tol)
+            else:
+                np.testing.assert_array_equal(
+                    np.asarray(p1[k]), np.asarray(p2[k]))
+
+
+# -- smoke (also run standalone by scripts/t1.sh at 2 devices) ----------------
+
+
+def test_smoke_bucketed_reduce_matches_monolithic():
+    """The bucketed f32 schedule is a re-bracketing of the same sum:
+    per-step scores and final params must be BIT-identical to the
+    monolithic all-reduce, at whatever device count the platform has."""
+    n_dev = min(len(jax.devices()), 8)
+    if n_dev < 2:
+        pytest.skip("needs >=2 devices")
+    mono, mono_tap = _fit_sharded(n_dev, bucket_bytes=0)
+    buck, buck_tap = _fit_sharded(n_dev, bucket_bytes=512)
+    assert mono_tap.scores == buck_tap.scores
+    _assert_params_equal(mono, buck)
+    # the bucketed plan actually split: >1 bucket at 512B on ~1 KB grads
+    desc = buck._mesh_plan.collective_describe(buck)
+    assert desc["mode"] == "bucketed" and desc["n_buckets"] > 1
+
+
+# -- 8-device suite -----------------------------------------------------------
+
+
+@needs_8
+def test_bucketed_bit_identical_across_bucket_sizes():
+    """Bucket size is a SCHEDULE knob, never a numerics knob: 0 (mono),
+    tiny (many buckets), and the 4 MiB default (one bucket here) all
+    land on identical trajectories."""
+    runs = [_fit_sharded(8, bucket_bytes=bb) for bb in (0, 512, None)]
+    (ref, ref_tap), rest = runs[0], runs[1:]
+    for net, tap in rest:
+        assert tap.scores == ref_tap.scores
+        _assert_params_equal(ref, net)
+
+
+@needs_8
+def test_bucketed_fused_dispatch_bit_identical():
+    """set_fused_steps composes with the bucketed schedule: K stacked
+    steps with per-bucket reduces still match the monolithic fused
+    run bit for bit."""
+    mono, _ = _fit_sharded(8, bucket_bytes=0, fused_steps=2)
+    buck, _ = _fit_sharded(8, bucket_bytes=512, fused_steps=2)
+    _assert_params_equal(mono, buck)
+
+
+@needs_8
+def test_bucketed_tbptt_bit_identical():
+    """The truncated-BPTT step (3-D grads, recurrent state threading)
+    reduces through the same bucket path: bucketed == monolithic."""
+    from deeplearning4j_tpu.models.charlstm import char_lstm_conf
+
+    vocab, seq = 11, 8
+    rng = np.random.default_rng(5)
+    idx = rng.integers(0, vocab, (16, seq))
+    x = np.eye(vocab, dtype=np.float32)[idx]
+    yidx = rng.integers(0, vocab, (16, seq))
+    y = np.eye(vocab, dtype=np.float32)[yidx]
+
+    def run(bb):
+        conf = char_lstm_conf(vocab_size=vocab, hidden=8, tbptt_length=4)
+        net = MultiLayerNetwork(conf).init().set_mesh(
+            _sub_mesh(8), bucket_bytes=bb)
+        net.fit(x, y, batch_size=16, epochs=2, async_prefetch=False)
+        return net
+
+    _assert_params_equal(run(0), run(256))
+
+
+@needs_8
+def test_bf16_wire_halves_books_and_stays_in_tolerance():
+    """grad_dtype="bf16" halves the all-reduce wire bytes (the books
+    the bench artifact commits) while the trajectory stays inside a
+    pinned tolerance of the f32 run — and the knob is OPT-IN: a plain
+    set_mesh stays f32."""
+    reg = get_registry()
+    ar = reg.counter(
+        "allreduce_bytes_total",
+        "gradient bytes all-reduced in-graph by the sharded "
+        "train step (logical payload: summed gradient leaf "
+        "bytes per optimizer step)").labels()
+
+    a0 = ar.value
+    f32, f32_tap = _fit_sharded(8, bucket_bytes=None)
+    f32_bytes = ar.value - a0
+    a0 = ar.value
+    bf16, bf16_tap = _fit_sharded(8, bucket_bytes=None, grad_dtype="bf16")
+    bf16_bytes = ar.value - a0
+
+    assert f32_bytes > 0 and bf16_bytes * 2 == f32_bytes
+    assert f32._mesh_plan.collective_describe(f32)["grad_dtype"] == "f32"
+    assert bf16._mesh_plan.collective_describe(bf16)["grad_dtype"] == "bf16"
+    # pinned trajectory tolerance: bf16 rounds the WIRE payload only
+    # (f32 accumulate), so after 8 tiny-lr steps the drift stays small
+    np.testing.assert_allclose(bf16_tap.scores, f32_tap.scores,
+                               rtol=5e-2, atol=5e-3)
+    _assert_params_equal(f32, bf16, rtol=5e-2, atol=5e-3)
+
+
+@needs_8
+def test_resume_from_through_bucketed_mesh(tmp_path):
+    """Mid-epoch resume_from round-trips through a bucketed mesh: crash
+    after k bucketed sharded steps, resume into a fresh bucketed net,
+    land on the uninterrupted run's trajectory."""
+    from deeplearning4j_tpu.train.checkpoint import CheckpointListener
+
+    x, y = _data(64, seed=11)
+    ckpt = str(tmp_path / "ckpt")
+
+    def mk():
+        return MultiLayerNetwork(_mlp_conf()).init().set_mesh(
+            _sub_mesh(8), bucket_bytes=512)
+
+    ref = mk()
+    ref_tap = _ScoreTap()
+    ref.set_listeners(ref_tap)
+    ref.fit(x, y, batch_size=16, epochs=2, async_prefetch=False)
+
+    class _CrashAfter(IterationListener):
+        def __init__(self, n):
+            self.n = n
+
+        def iteration_done(self, model, iteration, info):
+            self.n -= 1
+            if self.n == 0:
+                raise RuntimeError("simulated preemption")
+
+    crashed = mk()
+    crashed.set_listeners(
+        CheckpointListener(ckpt, every_n_iterations=1, every_n_epochs=None,
+                           keep_last=2),
+        _CrashAfter(5))
+    with pytest.raises(RuntimeError, match="simulated preemption"):
+        crashed.fit(x, y, batch_size=16, epochs=2, async_prefetch=False)
+
+    resumed = mk()
+    tap = _ScoreTap()
+    resumed.set_listeners(tap)
+    resumed.fit(x, y, batch_size=16, epochs=2, async_prefetch=False,
+                resume_from=ckpt)
+    assert resumed.iteration == ref.iteration == 8
+    np.testing.assert_allclose(tap.scores, ref_tap.scores[-len(tap.scores):],
+                               rtol=2e-5, atol=2e-6)
+    _assert_params_equal(ref, resumed, rtol=2e-5, atol=2e-6)
+
+
+@needs_8
+def test_measured_collective_counter_moves_when_sampled():
+    """train_step_collective_seconds{source="measured"} — the estimate's
+    falsifier — accumulates when devprof sampling is on, and stays put
+    under tier-1's sample_every=0 (the default this suite runs with)."""
+    from deeplearning4j_tpu.utils import devprof
+
+    reg = get_registry()
+    measured = reg.counter(
+        "train_step_collective_seconds",
+        "time attributed to the train step's gradient all-reduce, "
+        "by accounting source", ("source",)).labels("measured")
+    estimate = reg.counter(
+        "train_step_collective_seconds",
+        "time attributed to the train step's gradient all-reduce, "
+        "by accounting source", ("source",)).labels("estimate")
+
+    m0, e0 = measured.value, estimate.value
+    _fit_sharded(8, bucket_bytes=512)
+    assert estimate.value > e0  # the ring model always accrues
+    assert measured.value == m0  # sampling off -> no blocking probe
+
+    prev = devprof.get_profiler().sample_every
+    devprof.configure(1)
+    try:
+        m0 = measured.value
+        _fit_sharded(8, bucket_bytes=512)
+        assert measured.value > m0
+    finally:
+        devprof.configure(prev)
+
+
+@needs_8
+def test_set_mesh_rejects_knobs_with_explicit_plan():
+    """bucket_bytes/grad_dtype are plan-construction knobs: passing them
+    alongside a prebuilt plan= would silently ignore one of the two —
+    refuse instead."""
+    from deeplearning4j_tpu.parallel.sharded import MeshPlan
+
+    plan = MeshPlan(_sub_mesh(2))
+    net = MultiLayerNetwork(_mlp_conf()).init()
+    with pytest.raises(ValueError):
+        net.set_mesh(plan=plan, bucket_bytes=512)

@@ -582,3 +582,45 @@ def test_smoke_entrypoint_runs():
 
     v = dec.smoke(n_slots=3, vocab=7, hidden=8, requests=6)
     assert v["ok"] and v["zero_retraces"]
+
+
+# -- fused decode steps (PR 16) -----------------------------------------------
+
+
+@pytest.mark.parametrize("k", [2, 3, 4])
+def test_fused_steps_bit_identical_to_per_step(net, k):
+    """set_fused_steps(K) scans K decode steps into one jitted dispatch;
+    the in-graph argmax feedback must reproduce the per-step host
+    feedback EXACTLY — mixed prefill/decode positions, slot turnover
+    mid-window, the lot."""
+    rng = np.random.default_rng(7)
+    reqs = [(rng.integers(0, VOCAB, size=1 + i % 5).tolist(), 3 + i % 6)
+            for i in range(9)]
+    refs = [reference_decode(net, p, m) for p, m in reqs]
+    eng = DecodeEngine(net, n_slots=3, default_max_tokens=16,
+                       component_prefix=f"t_fused{k}")
+    eng.set_fused_steps(k)
+    try:
+        futs = [eng.generate(p, max_new_tokens=m) for p, m in reqs]
+        outs = [f.result(timeout=120) for f in futs]
+    finally:
+        eng.shutdown()
+    assert outs == refs
+
+
+def test_fused_steps_eos_mid_window_discards_tail(net):
+    """EOS landing mid-window: the tail tokens the fused dispatch
+    computed past it are discarded host-side — output and books match
+    the per-step engine."""
+    ref = reference_decode(net, [2, 5], 8)
+    eng = DecodeEngine(net, n_slots=1, eos_token=ref[0],
+                       default_max_tokens=8, component_prefix="t_feos")
+    eng.set_fused_steps(4)
+    try:
+        out = eng.generate([2, 5]).result(timeout=60)
+        m = eng.metrics()
+    finally:
+        eng.shutdown()
+    assert out == [ref[0]]
+    assert m["completed"] == 1 and m["slots_in_use"] == 0
+    assert m["tokens"] == 1  # tail window tokens never hit the books
