@@ -132,6 +132,74 @@ def _conv_flops(eqn) -> float:
     return 2.0 * (batch // batch_groups) * out_ch * in_ch_per_group * pairs
 
 
+def _same_pad_lo(in_sz: int, k_sz: int, stride: int) -> Tuple[int, int]:
+    """(out_sz, pad_lo) of one spatial dim under XLA SAME padding:
+    out = ceil(in/s), total pad = max((out-1)*s + k - in, 0), low half
+    first (XLA puts the extra pad on the high side)."""
+    out_sz = -(-in_sz // stride)
+    pad_total = max((out_sz - 1) * stride + k_sz - in_sz, 0)
+    return out_sz, pad_total // 2
+
+
+def conv_instance_cost(*, kernel, stride, x_shape, n_out: int,
+                       itemsize: int) -> dict:
+    """FLOPs and minimal HBM bytes of ONE bias-free SAME NHWC conv
+    instance, priced exactly like `_conv_flops` (HLO valid-pair
+    accounting — taps landing in padding do no work). Bytes are the
+    streaming floor: read x and w once, write y once; the fused stats
+    epilogue adds nothing. This is the per-instance analogue of the
+    per-family `CostModel.table()` rows, for kernel-routing decisions
+    that must be made per shape rather than per program."""
+    n, h, w, cin = (int(d) for d in x_shape)
+    kh, kw = int(kernel[0]), int(kernel[1])
+    sh, sw = int(stride[0]), int(stride[1])
+    ho, ph = _same_pad_lo(h, kh, sh)
+    wo, pw = _same_pad_lo(w, kw, sw)
+    pairs = (_conv_valid_pairs(ho, kh, h, sh, ph, 1, 1)
+             * _conv_valid_pairs(wo, kw, w, sw, pw, 1, 1))
+    flops = 2.0 * n * n_out * cin * pairs
+    bytes_ = itemsize * (n * h * w * cin + kh * kw * cin * n_out
+                         + n * ho * wo * n_out)
+    return {"flops": flops, "bytes": bytes_,
+            "out_shape": (n, ho, wo, int(n_out))}
+
+
+def bn_instance_cost(*, x_shape, itemsize: int, n_reads: int = 1,
+                     n_writes: int = 1) -> dict:
+    """FLOPs and bytes of one batch-norm pass over an NHWC activation:
+    a handful of elementwise ops per element (priced at 4 FLOP/elem),
+    `n_reads` full reads and `n_writes` full writes of the tensor.
+    Per-channel vectors are noise and not counted."""
+    numel = 1
+    for d in x_shape:
+        numel *= int(d)
+    return {"flops": 4.0 * numel,
+            "bytes": float(itemsize * numel * (n_reads + n_writes))}
+
+
+def instance_roofline(flops: float, bytes_: float,
+                      peak_flops: Optional[float] = None,
+                      hbm_bandwidth: Optional[float] = None) -> dict:
+    """Roofline verdict for a single op instance — the same ridge test
+    `CostModel.table()` applies per family, exposed for per-shape kernel
+    routing (`ops/pallas_conv_bn.conv_decision`). Off-TPU the v5e figures
+    stand in: routing models the TPU the kernels target, not the host."""
+    from deeplearning4j_tpu.utils import flops as _flops
+
+    peak = peak_flops or _flops.peak_flops_per_chip()
+    bw = hbm_bandwidth or _flops.hbm_bandwidth_per_chip()
+    ridge = peak / bw
+    intensity = flops / bytes_ if bytes_ else 0.0
+    return {
+        "flops": flops,
+        "bytes": bytes_,
+        "intensity": round(intensity, 3),
+        "ridge_intensity": round(ridge, 3),
+        "verdict": ("compute-bound" if intensity >= ridge
+                    else "memory-bound"),
+    }
+
+
 def _eqn_flops(eqn) -> float:
     p = eqn.primitive.name
     if p == "dot_general":

@@ -328,6 +328,21 @@ def cmd_perf(args) -> int:
     roof = cm.roofline()
     rows = cm.table()
     vs_prior = None if args.no_vs_prior else _perf_vs_prior(args.preset)
+    # per-conv-instance Pallas kernel routing (covered / declined-by-
+    # roofline / unsupported) — config-graph walking only, so it rides
+    # along free for any conv-bearing preset
+    coverage = None
+    try:
+        from deeplearning4j_tpu.analysis import kernelcoverage
+
+        cov_rows = kernelcoverage.coverage_table(net.conf,
+                                                 batch=args.batch)
+        if cov_rows:
+            coverage = {"rows": cov_rows,
+                        "summary": kernelcoverage.coverage_summary(
+                            cov_rows)}
+    except Exception as e:  # a coverage bug must not kill the cost model
+        coverage = {"error": f"{type(e).__name__}: {e}"}
     if args.json:
         payload = {
             "preset": args.preset,
@@ -335,6 +350,7 @@ def cmd_perf(args) -> int:
             "cost_model": cm.to_dict(),
             "roofline": roof,
             "families": rows,
+            "kernel_coverage": coverage,
             "xla": xla_stats,
             "vs_prior": vs_prior,
             "findings": [f.to_dict() for f in findings],
@@ -380,6 +396,13 @@ def cmd_perf(args) -> int:
         else:
             print("  XLA cross-check: cost_analysis unavailable on this "
                   "backend (skipped)")
+    if coverage and coverage.get("rows"):
+        from deeplearning4j_tpu.analysis import kernelcoverage
+
+        print()
+        print(kernelcoverage.format_table(coverage["rows"]))
+    elif coverage and coverage.get("error"):
+        print(f"  kernel coverage: unavailable ({coverage['error']})")
     if vs_prior:
         note = vs_prior.get("note")
         if note:

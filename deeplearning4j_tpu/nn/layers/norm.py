@@ -141,19 +141,34 @@ def _bn_train_bwd(eps, res, cts):
         # g*xc stay small — no large-mean cancellation in sum_gx
         xc = x - jnp.broadcast_to(mean_saved, x.shape)
         center = delta
-        g2 = g.reshape(n, c)
-        x2 = xc.reshape(n, c)
-        sum_g = _sum_to_f32(g2, n)
-        sum_gx = _sum_to_f32(g2 * x2, n) - center * sum_g
         x_for_dx = xc
     else:
         center = mean_saved
+        x_for_dx = x
+    # fused Pallas pullback when registered + supported: one reduce pass
+    # (both per-channel sums) + one apply pass instead of three separate
+    # XLA re-reads of the saved activation; same kill-switch/auto-disable
+    # containment as the forward helpers — a raising kernel disables
+    # itself and the builtin reductions below finish the same backward
+    helper = get_helper("bn_backward", x_shape=tuple(x.shape),
+                        dtype=x.dtype, training=True)
+    if helper is not None:
+        try:
+            dx, dgamma, dbeta = helper(g, x_for_dx, center, gamma, inv, n)
+            return dx, dgamma.astype(gamma.dtype), dbeta.astype(gamma.dtype)
+        except HelperError:
+            pass  # helper auto-disabled itself; builtin path below
+    if x.dtype == jnp.bfloat16:
+        g2 = g.reshape(n, c)
+        x2 = x_for_dx.reshape(n, c)
+        sum_g = _sum_to_f32(g2, n)
+        sum_gx = _sum_to_f32(g2 * x2, n) - center * sum_g
+    else:
         axes = tuple(range(x.ndim - 1))
         gf = g.astype(acc)
         xf = x.astype(acc)
         sum_g = jnp.sum(gf, axis=axes)
         sum_gx = jnp.sum(gf * xf, axis=axes) - center * sum_g
-        x_for_dx = x
     dgamma = (inv * sum_gx).astype(gamma.dtype)
     dbeta = sum_g.astype(gamma.dtype)
     gamma_f = gamma.astype(acc)

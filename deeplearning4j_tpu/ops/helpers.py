@@ -23,20 +23,27 @@ from deeplearning4j_tpu.utils import tracing as _tracing
 logger = logging.getLogger("deeplearning4j_tpu")
 
 
-def _count(metric: str, op: str, helper: str, reason: Optional[str] = None):
+def _count(metric: str, op: str, helper: str, family: str,
+           reason: Optional[str] = None):
     """Helper SPI events in the shared registry: selection hits,
-    builtin-path fallbacks (with why), and auto-disables. These happen at
-    trace time, not per device step, so a registry lookup per event is
-    fine — and it makes PR 2's "helper silently auto-disabled mid-run"
-    kill switch a scrape-able series instead of a bench-only check."""
+    builtin-path fallbacks (with why), and auto-disables, each carrying
+    the kernel FAMILY (e.g. conv3x3s2, bn_bwd) so per-family hit rates
+    are scrape-able — one op slot can route many shapes to many kernels.
+    Family values come from the registration's `family(**ctx)` callable,
+    which must return a bounded slug set (the metrics tests assert the
+    cardinality stays bounded). These happen at trace time, not per
+    device step, so a registry lookup per event is fine — and it makes
+    PR 2's "helper silently auto-disabled mid-run" kill switch a
+    scrape-able series instead of a bench-only check."""
     reg = _metrics.get_registry()
     if reason is None:
         reg.counter(metric, "Helper SPI events",
-                    ("op", "helper")).labels(op, helper).inc()
+                    ("op", "helper", "family")).labels(op, helper,
+                                                       family).inc()
     else:
         reg.counter(metric, "Helper SPI events",
-                    ("op", "helper", "reason")).labels(op, helper,
-                                                       reason).inc()
+                    ("op", "helper", "family",
+                     "reason")).labels(op, helper, family, reason).inc()
     if metric != "helper_hit_total":
         # fallbacks and auto-disables are rare, diagnosis-relevant events
         # — they ride in the flight recorder so a crash dump shows the
@@ -61,6 +68,7 @@ class Helper:
     fn: Callable
     supported: Callable[..., bool] = lambda **ctx: True
     enabled: bool = True
+    family: Optional[Callable[..., str]] = None
 
 
 _HELPERS: Dict[str, Helper] = {}
@@ -68,15 +76,28 @@ _HELPERS: Dict[str, Helper] = {}
 
 def register_helper(op: str, fn: Callable,
                     supported: Optional[Callable[..., bool]] = None,
-                    name: Optional[str] = None) -> None:
+                    name: Optional[str] = None,
+                    family: Optional[Callable[..., str]] = None) -> None:
     """Install a helper for an op slot ("lstm_sequence", "conv2d", ...).
     Last registration wins (the reference loads exactly one helper class
-    per layer type)."""
+    per layer type). `family(**ctx)` maps a call context to the bounded
+    kernel-family slug the helper metrics are labeled with (default: the
+    op name itself, which is trivially bounded)."""
     _HELPERS[op] = Helper(
         name=name or getattr(fn, "__name__", op),
         fn=fn,
         supported=supported or (lambda **ctx: True),
+        family=family,
     )
+
+
+def _family_of(op: str, h: Helper, ctx: dict) -> str:
+    if h.family is None:
+        return op
+    try:
+        return str(h.family(**ctx))
+    except Exception:  # a broken family fn must never kill the metric
+        return op
 
 
 def get_helper(op: str, **ctx) -> Optional[Callable]:
@@ -91,18 +112,19 @@ def get_helper(op: str, **ctx) -> Optional[Callable]:
     h = _HELPERS.get(op)
     if h is None:
         return None
+    fam = _family_of(op, h, ctx)
     if not h.enabled:
-        _count("helper_fallback_total", op, h.name, "disabled")
+        _count("helper_fallback_total", op, h.name, fam, "disabled")
         return None
     try:
         if not h.supported(**ctx):
-            _count("helper_fallback_total", op, h.name, "unsupported")
+            _count("helper_fallback_total", op, h.name, fam, "unsupported")
             return None
     except Exception as e:  # a broken probe must never kill the fallback
         logger.warning("helper %s probe failed: %s", h.name, e)
-        _count("helper_fallback_total", op, h.name, "probe_error")
+        _count("helper_fallback_total", op, h.name, fam, "probe_error")
         return None
-    _count("helper_hit_total", op, h.name)
+    _count("helper_hit_total", op, h.name, fam)
 
     def guarded(*args, **kwargs):
         try:
@@ -118,8 +140,8 @@ def get_helper(op: str, **ctx) -> Optional[Callable]:
                 "helper %s (op %s) raised %s: %s — helper disabled, "
                 "falling back to the built-in path", h.name, op,
                 type(e).__name__, e)
-            _count("helper_auto_disable_total", op, h.name)
-            _count("helper_fallback_total", op, h.name, "raised")
+            _count("helper_auto_disable_total", op, h.name, fam)
+            _count("helper_fallback_total", op, h.name, fam, "raised")
             _tracing.instant("helper/auto_disable", op=op, helper=h.name,
                              error=f"{type(e).__name__}: {e}")
             raise HelperError(f"helper {h.name} failed: {e}") from e

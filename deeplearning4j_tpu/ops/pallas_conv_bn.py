@@ -29,24 +29,37 @@ Two helper slots (ops/helpers.py), mirroring the reference's plugin pair
 Scope (checked by the probes; everything else falls back silently to the
 XLA lowering, exactly like the cuDNN checkSupported fallback): NHWC,
 bf16 on real TPU, training mode, bias-free identity-activation convs with
-kernel 1x1 (stride 1 or 2) or 3x3 (stride 1), SAME padding, no dilation
-— the shapes of every ResNet bottleneck conv except the 7x7 stem and the
-three stage-entry 3x3/s2 convs.
+SAME padding, no dilation, and kernel/stride in {1x1 (stride 1 or 2),
+3x3 (stride 1 or 2), 7x7 (stride 2)} — every conv instance of the
+ResNet-50 trunk, stem included (53/53). Structural support is necessary
+but not sufficient: `conv_decision` then consults the per-instance
+roofline (`analysis/costmodel.instance_roofline`) and DECLINES
+compute-bound instances — an MXU-saturating conv gains nothing from the
+stats epilogue and must never regress through the helper; only
+memory-bound instances route to the kernel.
 
 Backward is a hand-written custom_vjp pair: the conv pullback is the
 standard pair of transposed XLA convolutions (jax.linear_transpose of the
 reference lowering — already MXU-shaped; Pallas buys nothing there), and
 the BN pullback reuses the fused-BN VJP structure of nn/layers/norm.py
 (per-channel coefficients in the f32 accumulator dtype, every full-size
-tensor in x.dtype). The stats outputs are stop_gradient'ed at the stash:
-the BN backward's dx is the TOTAL derivative including the statistics
-paths (same composite as norm.py's `_bn_train`), so routing any cotangent
-through the stats tensors as well would double-count.
+tensor in x.dtype). The per-channel reductions of that pullback (sum g,
+sum g·x) and the dx normalization are themselves Pallas-fused here — one
+reduce pass + one apply pass over the saved activations instead of
+XLA's three separate re-reads — registered as a third helper slot
+("bn_backward") consumed both by `bn_apply`'s VJP and by
+nn/layers/norm.py's built-in `_bn_train` backward, behind the same
+kill-switch/auto-disable machinery. The stats outputs are
+stop_gradient'ed at the stash: the BN backward's dx is the TOTAL
+derivative including the statistics paths (same composite as norm.py's
+`_bn_train`), so routing any cotangent through the stats tensors as well
+would double-count.
 """
 
 from __future__ import annotations
 
 import logging
+import os
 from collections import deque
 from functools import partial
 
@@ -58,7 +71,19 @@ from jax.experimental.pallas import tpu as pltpu
 
 logger = logging.getLogger("deeplearning4j_tpu")
 
-_INTERPRET = False  # flipped by tests on CPU (same pattern as pallas_lstm)
+# Interpret mode runs the kernels as a jaxpr interpreter on any backend —
+# the CPU-correctness/bench configuration (same pattern as pallas_lstm).
+# Tests flip the module flag directly; bench flips it via set_interpret;
+# DL4J_PALLAS_INTERPRET=1 forces it from the environment.
+_INTERPRET = os.environ.get("DL4J_PALLAS_INTERPRET", "0") == "1"
+
+
+def set_interpret(on: bool) -> None:
+    """Run the Pallas kernels in interpret mode (any backend). Used by
+    bench.py for the CPU-interpret helper A/B; tests set the module flag
+    directly through their fixture."""
+    global _INTERPRET
+    _INTERPRET = bool(on)
 
 _DIMS2D = ("NHWC", "HWIO", "NHWC")
 
@@ -193,9 +218,40 @@ def _mm_stats_call(x2, w2):
     return y2, s1, s2
 
 
-# -- 3x3 stride-1 SAME conv with stats epilogue ------------------------------
+# -- kxk strided SAME conv with stats epilogue -------------------------------
 
-def _c3_stats_kernel(x_ref, w_ref, y_ref, s1_ref, s2_ref):
+def _same_out_pad(in_sz: int, k: int, s: int):
+    """(out_sz, pad_lo) of one spatial dim under XLA SAME padding (extra
+    pad goes on the high side — must match the reference lowering the
+    backward transposes and the tests compare against)."""
+    out_sz = -(-in_sz // s)
+    return out_sz, max((out_sz - 1) * s + k - in_sz, 0) // 2
+
+
+def _conv_taps(h: int, w: int, kh: int, kw: int, sh: int, sw: int):
+    """Static per-tap slice plan for a SAME kxk/s conv: for each kernel
+    tap (a, b), the output range where the tap lands inside the image and
+    the matching strided input origin. All values are Python ints, so the
+    kernel below unrolls to kh*kw clipped dots with static slices."""
+    ho, ph = _same_out_pad(h, kh, sh)
+    wo, pw = _same_out_pad(w, kw, sw)
+    rows = []
+    for a in range(kh):
+        o0 = max(0, -((a - ph) // sh)) if a < ph else 0
+        o1 = min(ho, (h - 1 + ph - a) // sh + 1)
+        if o1 > o0:
+            rows.append((a, o0, o1, o0 * sh + a - ph))
+    cols = []
+    for b in range(kw):
+        o0 = max(0, -((b - pw) // sw)) if b < pw else 0
+        o1 = min(wo, (w - 1 + pw - b) // sw + 1)
+        if o1 > o0:
+            cols.append((b, o0, o1, o0 * sw + b - pw))
+    taps = tuple((ra, rb) for ra in rows for rb in cols)
+    return ho, wo, taps
+
+
+def _ck_stats_kernel(x_ref, w_ref, y_ref, s1_ref, s2_ref, *, taps, sh, sw):
     n = pl.program_id(0)
 
     @pl.when(n == 0)
@@ -204,53 +260,61 @@ def _c3_stats_kernel(x_ref, w_ref, y_ref, s1_ref, s2_ref):
         s2_ref[:] = jnp.zeros_like(s2_ref)
 
     acc_dt = s1_ref.dtype
-    h, w = y_ref.shape[1], y_ref.shape[2]
+    ho, wo = y_ref.shape[1], y_ref.shape[2]
     cout = y_ref.shape[3]
-    acc = jnp.zeros((h, w, cout), acc_dt)
+    cin = x_ref.shape[3]
+    acc = jnp.zeros((ho, wo, cout), acc_dt)
     x = x_ref[0]
-    # 9 shifted whole-image dots accumulated in VMEM. The SAME-padding
-    # halo is handled by clipping each shift to its valid region (static
-    # slices) instead of pre-padding the input — a jnp.pad outside the
-    # kernel would materialize a full padded copy to HBM, spending the
-    # very read the stats epilogue saves.
-    for a in (-1, 0, 1):
-        i0, i1 = max(0, -a), h - max(0, a)
-        for b in (-1, 0, 1):
-            j0, j1 = max(0, -b), w - max(0, b)
-            part = lax.dot_general(
-                x[i0 + a:i1 + a, j0 + b:j1 + b, :],
-                w_ref[a + 1, b + 1],
-                (((2,), (0,)), ((), ())),
-                preferred_element_type=acc_dt,
-            )
-            # zero-extend the clipped partial back to (h, w) and add —
-            # in-register pad; .at[...].add would capture index constants
-            # the kernel tracer rejects
-            acc = acc + lax.pad(
-                part, jnp.asarray(0, acc_dt),
-                ((i0, h - i1, 0), (j0, w - j1, 0), (0, 0, 0)))
+    # kh*kw shifted whole-image dots accumulated in VMEM. The SAME-padding
+    # halo is handled by clipping each tap to its valid output region
+    # (static slices) instead of pre-padding the input — a jnp.pad outside
+    # the kernel would materialize a full padded copy to HBM, spending the
+    # very read the stats epilogue saves. Stride > 1 subsamples the input
+    # rows/cols of each tap with a static strided slice.
+    for (a, oh0, oh1, ih0), (b, ow0, ow1, iw0) in taps:
+        ch, cw = oh1 - oh0, ow1 - ow0
+        if sh == 1 and sw == 1:
+            xs = x[ih0:ih0 + ch, iw0:iw0 + cw, :]
+        else:
+            xs = lax.slice(x, (ih0, iw0, 0),
+                           (ih0 + (ch - 1) * sh + 1,
+                            iw0 + (cw - 1) * sw + 1, cin),
+                           (sh, sw, 1))
+        part = lax.dot_general(
+            xs, w_ref[a, b],
+            (((2,), (0,)), ((), ())),
+            preferred_element_type=acc_dt,
+        )
+        # zero-extend the clipped partial back to (ho, wo) and add —
+        # in-register pad; .at[...].add would capture index constants
+        # the kernel tracer rejects
+        acc = acc + lax.pad(
+            part, jnp.asarray(0, acc_dt),
+            ((oh0, ho - oh1, 0), (ow0, wo - ow1, 0), (0, 0, 0)))
     yb = acc.astype(y_ref.dtype)
     y_ref[0] = yb
-    yf = yb.astype(acc_dt).reshape(h * w, cout)
+    yf = yb.astype(acc_dt).reshape(ho * wo, cout)
     s1_ref[:] += jnp.sum(yf, axis=0, keepdims=True)
     s2_ref[:] += jnp.sum(yf * yf, axis=0, keepdims=True)
 
 
-def _c3_stats_call(x, w):
+def _ck_stats_call(x, w, strides):
     n, h, wd, cin = x.shape
-    cout = w.shape[3]
+    kh, kw, _, cout = w.shape
+    sh, sw = strides
+    ho, wo, taps = _conv_taps(h, wd, kh, kw, sh, sw)
     acc = _acc_dtype(x.dtype)
     y, s1, s2 = pl.pallas_call(
-        _c3_stats_kernel,
+        partial(_ck_stats_kernel, taps=taps, sh=sh, sw=sw),
         grid=(n,),
         in_specs=[
             pl.BlockSpec((1, h, wd, cin), lambda i: (i, 0, 0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((3, 3, cin, cout), lambda i: (0, 0, 0, 0),
+            pl.BlockSpec((kh, kw, cin, cout), lambda i: (0, 0, 0, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=[
-            pl.BlockSpec((1, h, wd, cout), lambda i: (i, 0, 0, 0),
+            pl.BlockSpec((1, ho, wo, cout), lambda i: (i, 0, 0, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, cout), lambda i: (0, 0),
                          memory_space=pltpu.VMEM),
@@ -258,7 +322,7 @@ def _c3_stats_call(x, w):
                          memory_space=pltpu.VMEM),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((n, h, wd, cout), x.dtype),
+            jax.ShapeDtypeStruct((n, ho, wo, cout), x.dtype),
             jax.ShapeDtypeStruct((1, cout), acc),
             jax.ShapeDtypeStruct((1, cout), acc),
         ],
@@ -275,8 +339,8 @@ def conv2d_bn_stats(x, w, strides):
     per-channel f32 statistics are computed as a VMEM epilogue of the conv
     output tiles — zero extra HBM traffic for the reduction.
 
-    x: [N,H,W,Cin]; w: [kh,kw,Cin,Cout] with (kh,kw) in {(1,1),(3,3)};
-    strides: static (sh,sw) — (1,1), or (2,2) for 1x1 kernels.
+    x: [N,H,W,Cin]; w: [kh,kw,Cin,Cout] with (kh,kw)/(sh,sw) in
+    {1x1/s1, 1x1/s2, 3x3/s1, 3x3/s2, 7x7/s2}; strides static.
 
     The statistics outputs carry NO gradient (see module docstring: the
     paired `bn_apply` backward computes the total dx including the stats
@@ -298,8 +362,9 @@ def _conv_fwd_impl(x, w, strides):
         y2, s1, s2 = _mm_stats_call(x.reshape(n * h * wd, cin),
                                     w.reshape(cin, cout))
         return y2.reshape(n, h, wd, cout), s1[0], s2[0]
-    # 3x3 stride 1 SAME: full image per grid step, halo clipped in-kernel
-    y, s1, s2 = _c3_stats_call(x, w)
+    # kxk SAME (stride 1 or 2): full image per grid step, halo clipped
+    # and stride subsampled in-kernel
+    y, s1, s2 = _ck_stats_call(x, w, strides)
     return y, s1[0], s2[0]
 
 
@@ -380,6 +445,156 @@ def _col_sums(x2, acc_dt):
                            preferred_element_type=acc_dt)
 
 
+# -- fused BN-backward epilogue ----------------------------------------------
+#
+# The fused-BN pullback needs two per-channel reductions over full-size
+# tensors (sum g, sum g·x) and then one elementwise pass producing dx.
+# XLA lowers the builtin form as three separate reductions/maps that each
+# re-read the saved activation from HBM; these two kernels do it in one
+# reduce pass (both sums per tile while g and x are in VMEM) plus one
+# apply pass — the backward twin of the forward stats epilogue.
+
+def _bnb_reduce_kernel(g_ref, x_ref, sg_ref, sgx_ref):
+    t = pl.program_id(0)
+
+    @pl.when(t == 0)
+    def _():
+        sg_ref[:] = jnp.zeros_like(sg_ref)
+        sgx_ref[:] = jnp.zeros_like(sgx_ref)
+
+    acc_dt = sg_ref.dtype
+    g = g_ref[:].astype(acc_dt)
+    sg_ref[:] += jnp.sum(g, axis=0, keepdims=True)
+    sgx_ref[:] += jnp.sum(g * x_ref[:].astype(acc_dt), axis=0,
+                          keepdims=True)
+
+
+def _bnb_apply_kernel(g_ref, x_ref, c1_ref, c3_ref, c0_ref, dx_ref):
+    dt = dx_ref.dtype
+    dx_ref[:] = (c1_ref[:].astype(dt) * g_ref[:]
+                 - c3_ref[:].astype(dt) * x_ref[:]
+                 + c0_ref[:].astype(dt))
+
+
+def _bnb_reduce_call(g2, x2):
+    m, c = g2.shape
+    acc = _acc_dtype(g2.dtype)
+    tm = _row_tile(m)
+    return pl.pallas_call(
+        _bnb_reduce_kernel,
+        grid=(m // tm,),
+        in_specs=[
+            pl.BlockSpec((tm, c), lambda t: (t, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((tm, c), lambda t: (t, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, c), lambda t: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, c), lambda t: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, c), acc),
+            jax.ShapeDtypeStruct((1, c), acc),
+        ],
+        interpret=_INTERPRET,
+    )(g2, x2)
+
+
+def _bnb_apply_call(g2, x2, c1, c3, c0):
+    m, c = g2.shape
+    tm = _row_tile(m)
+    return pl.pallas_call(
+        _bnb_apply_kernel,
+        grid=(m // tm,),
+        in_specs=[
+            pl.BlockSpec((tm, c), lambda t: (t, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((tm, c), lambda t: (t, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, c), lambda t: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, c), lambda t: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, c), lambda t: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((tm, c), lambda t: (t, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((m, c), g2.dtype),
+        interpret=_INTERPRET,
+    )(g2, x2, c1, c3, c0)
+
+
+def bn_backward_fused(g, x_for_dx, center, gamma, inv, n):
+    """The fused-BN pullback's heavy lifting in two Pallas passes.
+
+    g:        activation-dtype cotangent (already ReLU-gated if fused);
+    x_for_dx: the tensor the dx formula is affine in — centered x for the
+              bf16 path, raw x for the f32 path (norm.py `_bn_train_bwd`);
+    center:   accumulator-dtype per-channel recentering constant — delta
+              (mean's rounding error) for bf16, mean for f32 — so
+              sum_gx = Σ g·x_for_dx − center·Σ g matches the builtin;
+    gamma/inv: per-channel scale and rsqrt(var+eps); n: reduced elements.
+
+    Returns (dx, dgamma, dbeta) with dx in g.dtype and dgamma/dbeta in
+    the accumulator dtype (callers cast to the parameter dtype). The
+    coefficient algebra is EXACTLY norm.py's `_bn_train_bwd`; only the
+    reductions and the elementwise map are fused."""
+    c = g.shape[-1]
+    acc = _acc_dtype(g.dtype)
+    g2 = g.reshape(n, c)
+    x2 = x_for_dx.reshape(n, c)
+    sg, sgx_raw = _bnb_reduce_call(g2, x2)
+    sum_g = sg[0]
+    sum_gx = sgx_raw[0] - center.astype(acc) * sum_g
+    gamma_f = gamma.astype(acc)
+    dgamma = inv * sum_gx
+    dbeta = sum_g
+    c1 = gamma_f * inv
+    c3 = gamma_f * inv * inv * inv * sum_gx / n
+    c0 = -(c1 * sum_g / n) + c3 * center.astype(acc)
+    dx2 = _bnb_apply_call(g2, x2, c1[None, :], c3[None, :], c0[None, :])
+    return dx2.reshape(g.shape), dgamma, dbeta
+
+
+def _bn_backward_pieces(g, x, mean, inv, gamma, n):
+    """(x_for_dx, center) for the dtype-appropriate recentering, then the
+    fused backward if the "bn_backward" helper engages, else the builtin
+    reductions — shared by `_bn_bwd` below and norm.py's `_bn_train_bwd`.
+    Returns (dx, dgamma, dbeta) in (x.dtype, acc, acc)."""
+    from deeplearning4j_tpu.ops.helpers import HelperError, get_helper
+
+    c = x.shape[-1]
+    acc = _acc_dtype(x.dtype)
+    if x.dtype == jnp.bfloat16:
+        mean_b = mean.astype(x.dtype)
+        center = mean - mean_b.astype(acc)  # delta: mean's rounding error
+        x_for_dx = x - jnp.broadcast_to(mean_b, x.shape)
+    else:
+        center = mean
+        x_for_dx = x
+    helper = get_helper("bn_backward", x_shape=tuple(x.shape),
+                        dtype=x.dtype, training=True)
+    if helper is not None:
+        try:
+            return helper(g, x_for_dx, center, gamma, inv, n)
+        except HelperError:
+            pass  # helper auto-disabled itself; builtin path below
+    g2 = g.astype(acc) if x.dtype != jnp.bfloat16 else g
+    g2 = g2.reshape(n, c)
+    x2 = (x_for_dx.astype(acc)
+          if x.dtype != jnp.bfloat16 else x_for_dx).reshape(n, c)
+    if x.dtype == jnp.bfloat16:
+        sum_g = _col_sums(g2, acc)
+        sum_gx = _col_sums(g2 * x2, acc) - center * sum_g
+    else:
+        sum_g = jnp.sum(g2, axis=0)
+        sum_gx = jnp.sum(g2 * x2, axis=0) - center * sum_g
+    gamma_f = gamma.astype(acc)
+    dgamma = inv * sum_gx
+    dbeta = sum_g
+    c1 = gamma_f * inv
+    c3 = gamma_f * inv * inv * inv * sum_gx / n
+    c0 = -(c1 * sum_g / n) + c3 * center
+    dx = (c1.astype(x.dtype) * g - c3.astype(x.dtype) * x_for_dx
+          + c0.astype(x.dtype))
+    return dx, dgamma, dbeta
+
+
 @partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
 def bn_apply(x, s1, s2, gamma, beta, eps, n, relu):
     """Training-mode batch norm from precomputed raw moments: one fused
@@ -423,36 +638,12 @@ def _bn_bwd(eps, n, relu, res, cts):
     if relu:
         g = jnp.where(y > 0, g, jnp.zeros_like(g))
     c = x.shape[-1]
-    acc = _acc_dtype(x.dtype)
-    if x.dtype == jnp.bfloat16:
-        mean_b = mean.astype(x.dtype)
-        delta = mean - mean_b.astype(acc)
-        xc = x - jnp.broadcast_to(mean_b, x.shape)
-        g2 = g.reshape(n, c)
-        x2 = xc.reshape(n, c)
-        sum_g = _col_sums(g2, acc)
-        sum_gx = _col_sums(g2 * x2, acc) - delta * sum_g
-        center = delta
-        x_for_dx = xc
-    else:
-        g2 = g.astype(acc).reshape(n, c)
-        x2 = x.astype(acc).reshape(n, c)
-        sum_g = jnp.sum(g2, axis=0)
-        sum_gx = jnp.sum(g2 * x2, axis=0) - mean * sum_g
-        center = mean
-        x_for_dx = x
-    dgamma = (inv * sum_gx).astype(gamma.dtype)
-    dbeta = sum_g.astype(gamma.dtype)
-    gamma_f = gamma.astype(acc)
-    c1 = gamma_f * inv
-    c3 = gamma_f * inv * inv * inv * sum_gx / n
-    c0 = -(c1 * sum_g / n) + c3 * center
-    dx = (c1.astype(x.dtype) * g - c3.astype(x.dtype) * x_for_dx
-          + c0.astype(x.dtype))
+    dx, dgamma, dbeta = _bn_backward_pieces(g, x, mean, inv, gamma, n)
     # dx is the TOTAL derivative (elementwise + both statistics paths);
     # the raw-moment inputs therefore receive zero cotangent.
     zs = jnp.zeros((c,), _acc_dtype(x.dtype))
-    return dx, zs, zs, dgamma, dbeta
+    return (dx, zs, zs, dgamma.astype(gamma.dtype),
+            dbeta.astype(gamma.dtype))
 
 
 bn_apply.defvjp(_bn_fwd, _bn_bwd)
@@ -462,64 +653,178 @@ bn_apply.defvjp(_bn_fwd, _bn_bwd)
 
 _VMEM_BUDGET = 12 * 1024 * 1024  # leave headroom under the ~16MB/core VMEM
 
+# the structural whitelist: every ResNet-50 trunk conv is one of these
+_KERNEL_STRIDES = {
+    ((1, 1), (1, 1)): "conv1x1",
+    ((1, 1), (2, 2)): "conv1x1s2",
+    ((3, 3), (1, 1)): "conv3x3",
+    ((3, 3), (2, 2)): "conv3x3s2",
+    ((7, 7), (2, 2)): "conv7x7s2",
+}
 
-def _conv_vmem_ok(kernel, x_shape, n_in, n_out, itemsize) -> bool:
-    if kernel == (3, 3):
-        h, w = x_shape[1], x_shape[2]
-        slab = h * w * n_in * itemsize  # one full input image
-        out = h * w * n_out * itemsize
-        accf = h * w * n_out * 4
-        wgt = 9 * n_in * n_out * itemsize
-        return 2 * (slab + out) + accf + wgt <= _VMEM_BUDGET
-    wgt = n_in * n_out * itemsize
-    tm = 128 if n_in * n_out >= 1024 * 1024 else 512
-    tiles = 2 * tm * (n_in + n_out) * itemsize
-    return wgt + tiles <= _VMEM_BUDGET
+
+def conv_family(*, kernel=None, stride=None, **_):
+    """Bounded kernel-family slug for the helper metrics labels: one of
+    the five covered kernel/stride shapes, else "conv_other"."""
+    if kernel is None or stride is None:
+        return "conv_other"
+    return _KERNEL_STRIDES.get((tuple(kernel), tuple(stride)), "conv_other")
+
+
+def _conv_vmem_ok(kernel, stride, x_shape, n_in, n_out, itemsize) -> bool:
+    kh, kw = kernel
+    if (kh, kw) == (1, 1):
+        wgt = n_in * n_out * itemsize
+        tm = 128 if n_in * n_out >= 1024 * 1024 else 512
+        tiles = 2 * tm * (n_in + n_out) * itemsize
+        return wgt + tiles <= _VMEM_BUDGET
+    h, w = x_shape[1], x_shape[2]
+    ho = -(-h // stride[0])
+    wo = -(-w // stride[1])
+    slab = h * w * n_in * itemsize  # one full input image
+    out = ho * wo * n_out * itemsize
+    accf = ho * wo * n_out * 4
+    wgt = kh * kw * n_in * n_out * itemsize
+    return 2 * (slab + out) + accf + wgt <= _VMEM_BUDGET
+
+
+def conv_decision(*, kernel, stride, dilation, same, has_bias, activation,
+                  dtype, n_in, n_out, x_shape, training, planning=False,
+                  **_):
+    """Routing decision for the "conv2d" slot, in two stages:
+
+    1. structural: the kernel must EXIST for the shape (bias-free SAME
+       identity conv, kernel/stride in `_KERNEL_STRIDES`, channels that
+       tile the 128-lane registers, the whole image inside the VMEM
+       budget) — failures are "unsupported", the cuDNN checkSupported
+       pattern;
+    2. economic: the per-instance roofline verdict
+       (analysis/costmodel.instance_roofline). The stats epilogue saves
+       an HBM read — worth exactly nothing on an MXU-saturating conv, so
+       compute-bound instances are "declined" and keep the XLA lowering:
+       a compute-bound shape can never regress through the helper.
+
+    Returns {"status": "covered"|"declined"|"unsupported", "reason",
+    "family", "roofline"} — `cli perf`'s coverage table prints exactly
+    this. planning=True models the TPU routing decision regardless of
+    the local backend/interpret state (used by the coverage table and
+    the T1 kernel-coverage smoke on CPU hosts)."""
+    fam = conv_family(kernel=kernel, stride=stride)
+
+    def uns(reason):
+        return {"status": "unsupported", "reason": reason, "family": fam,
+                "roofline": None}
+
+    if not training:
+        return uns("inference")
+    if has_bias:
+        return uns("bias")
+    if not same:
+        return uns("padding")
+    if activation not in (None, "identity"):
+        return uns("fused_activation")
+    if tuple(dilation) != (1, 1):
+        return uns("dilation")
+    k, s = tuple(kernel), tuple(stride)
+    if (k, s) not in _KERNEL_STRIDES:
+        return uns("kernel_shape")
+    if planning:
+        pass  # model the TPU decision for any local backend/dtype
+    elif _INTERPRET:
+        # CPU correctness/bench mode: any float dtype, tiny channels
+        if not jnp.issubdtype(dtype, jnp.floating):
+            return uns("dtype")
+    else:
+        if jax.default_backend() != "tpu":
+            return uns("backend")
+        if dtype != jnp.bfloat16:
+            return uns("dtype")
+    if planning or not _INTERPRET:
+        # trunk channel counts tile the 128-lane registers cleanly; the
+        # 7x7 stem's 3 input channels ride the (padded) contraction dim
+        if (n_in % 64 and not (k == (7, 7) and n_in <= 4)) or n_out % 64:
+            return uns("channel_alignment")
+        if not _conv_vmem_ok(k, s, x_shape, n_in, n_out,
+                             jnp.dtype(dtype).itemsize):
+            return uns("vmem")
+    from deeplearning4j_tpu.analysis.costmodel import (
+        conv_instance_cost,
+        instance_roofline,
+    )
+
+    cost = conv_instance_cost(kernel=k, stride=s, x_shape=x_shape,
+                              n_out=n_out,
+                              itemsize=jnp.dtype(dtype).itemsize)
+    rf = instance_roofline(cost["flops"], cost["bytes"])
+    if rf["verdict"] == "compute-bound":
+        return {"status": "declined", "reason": "compute_bound",
+                "family": fam, "roofline": rf}
+    return {"status": "covered", "reason": "memory_bound", "family": fam,
+            "roofline": rf}
 
 
 def conv_supported(*, kernel, stride, dilation, same, has_bias, activation,
                    dtype, n_in, n_out, x_shape, training, **_):
-    """Probe for the "conv2d" slot. Whitelists exactly the ResNet-stage
-    conv shapes the kernels cover; everything else (stem 7x7, stage-entry
-    3x3/s2, biased or activated convs, inference) falls back to the XLA
-    lowering — the cuDNN checkSupported pattern."""
-    if not training or has_bias or not same:
-        return False
-    if activation not in (None, "identity"):
-        return False
-    if tuple(dilation) != (1, 1):
-        return False
-    k, s = tuple(kernel), tuple(stride)
-    if k == (1, 1):
-        if s not in ((1, 1), (2, 2)):
-            return False
-    elif k == (3, 3):
-        if s != (1, 1):
-            return False
-    else:
-        return False
-    if _INTERPRET:  # CPU correctness tests: any float dtype / tiny channels
-        return jnp.issubdtype(dtype, jnp.floating)
-    if jax.default_backend() != "tpu" or dtype != jnp.bfloat16:
-        return False
-    # ResNet trunk channel counts tile the 128-lane registers cleanly
-    if n_in % 64 or n_out % 64:
-        return False
-    return _conv_vmem_ok(k, x_shape, n_in, n_out, jnp.dtype(dtype).itemsize)
+    """Probe for the "conv2d" slot — thin wrapper over `conv_decision`:
+    engage the kernel only when the instance is structurally covered AND
+    memory-bound on the roofline."""
+    return conv_decision(
+        kernel=kernel, stride=stride, dilation=dilation, same=same,
+        has_bias=has_bias, activation=activation, dtype=dtype, n_in=n_in,
+        n_out=n_out, x_shape=x_shape, training=training,
+    )["status"] == "covered"
 
 
 def bn_supported(*, x, training, **_):
     """Probe for the "batch_norm" slot: only engages when the input IS a
     stashed conv-epilogue output (identity match) — otherwise the built-in
     fused XLA path is already optimal (it needs the stats reduction
-    anyway)."""
+    anyway). The normalize pass is a pure streaming map (≈2 FLOP/byte),
+    so the per-instance roofline consult can only say memory-bound; it
+    runs anyway so the routing stays cost-model-driven by construction."""
     if not training or not hasattr(x, "ndim") or x.ndim != 4:
         return False
-    if _INTERPRET:
-        return peek_stats(x)
-    if jax.default_backend() != "tpu" or x.dtype != jnp.bfloat16:
+    if not _INTERPRET:
+        if jax.default_backend() != "tpu" or x.dtype != jnp.bfloat16:
+            return False
+    if not peek_stats(x):
         return False
-    return peek_stats(x)
+    from deeplearning4j_tpu.analysis.costmodel import (
+        bn_instance_cost,
+        instance_roofline,
+    )
+
+    cost = bn_instance_cost(x_shape=tuple(x.shape),
+                            itemsize=jnp.dtype(x.dtype).itemsize)
+    return instance_roofline(cost["flops"],
+                             cost["bytes"])["verdict"] == "memory-bound"
+
+
+def bn_bwd_supported(*, x_shape, dtype, training, **_):
+    """Probe for the "bn_backward" slot (the fused reduce+apply pullback).
+    Same backend/dtype scope as the forward kernels; the roofline consult
+    prices the pullback's traffic (read g and x twice, write dx once) —
+    like the normalize it is structurally memory-bound, and the consult
+    keeps that a checked fact rather than an assumption."""
+    if not training or len(x_shape) < 2:
+        return False
+    if not _INTERPRET:
+        if jax.default_backend() != "tpu" or dtype != jnp.bfloat16:
+            return False
+        if x_shape[-1] % 64:
+            return False
+    elif not jnp.issubdtype(dtype, jnp.floating):
+        return False
+    from deeplearning4j_tpu.analysis.costmodel import (
+        bn_instance_cost,
+        instance_roofline,
+    )
+
+    cost = bn_instance_cost(x_shape=tuple(x_shape),
+                            itemsize=jnp.dtype(dtype).itemsize,
+                            n_reads=4, n_writes=1)
+    return instance_roofline(cost["flops"],
+                             cost["bytes"])["verdict"] == "memory-bound"
 
 
 def _conv2d_helper(x, w, *, strides):
@@ -549,9 +854,13 @@ def register():
     from deeplearning4j_tpu.ops.helpers import register_helper
 
     register_helper("conv2d", _conv2d_helper, conv_supported,
-                    name="pallas_conv_bn_stats")
+                    name="pallas_conv_bn_stats", family=conv_family)
     register_helper("batch_norm", _bn_helper, bn_supported,
-                    name="pallas_fused_bn_apply")
+                    name="pallas_fused_bn_apply",
+                    family=lambda **_: "bn_apply")
+    register_helper("bn_backward", bn_backward_fused, bn_bwd_supported,
+                    name="pallas_fused_bn_bwd",
+                    family=lambda **_: "bn_bwd")
 
 
 register()

@@ -336,9 +336,9 @@ def register():
     from deeplearning4j_tpu.ops.helpers import register_helper
 
     register_helper("lstm_sequence", lstm_sequence, supported,
-                    name="pallas_fused_lstm")
+                    name="pallas_fused_lstm", family=lambda **_: "lstm_seq")
     register_helper("lstm_decode_step", lstm_step, step_supported,
-                    name="pallas_lstm_step")
+                    name="pallas_lstm_step", family=lambda **_: "lstm_step")
 
 
 register()

@@ -179,7 +179,7 @@ class _helpers_disabled:
     cost-model trace, restoring the caller's kill-switch state on exit
     (the same save/restore discipline as bench._run_ab)."""
 
-    _OPS = ("conv2d", "batch_norm", "lstm_sequence")
+    _OPS = ("conv2d", "batch_norm", "bn_backward", "lstm_sequence")
 
     def __enter__(self):
         from deeplearning4j_tpu.ops.helpers import (

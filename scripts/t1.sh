@@ -66,6 +66,22 @@ else
     exit 1
 fi
 
+# -- kernel-coverage smoke ----------------------------------------------------
+# The 53/53 contract (analysis/kernelcoverage.py): every ResNet-50 conv
+# instance must resolve to covered or declined-with-roofline-verdict in
+# planning mode — a silently-unsupported shape is a kernel-family hole
+# nobody decided on, and fails the gate. Pure config walking, no trace.
+rm -f /tmp/_t1_kcov.log
+if timeout -k 10 120 env JAX_PLATFORMS=cpu \
+    python -m deeplearning4j_tpu.analysis.kernelcoverage --preset resnet50 \
+    > /tmp/_t1_kcov.log 2>&1; then
+    echo "T1 KERNEL COVERAGE: ok ($(tail -1 /tmp/_t1_kcov.log))"
+else
+    echo "T1 KERNEL COVERAGE: FAILED — tail of /tmp/_t1_kcov.log:"
+    tail -20 /tmp/_t1_kcov.log
+    exit 1
+fi
+
 # -- the canonical tier-1 pytest run -----------------------------------------
 # T1_METRICS_DUMP=1 makes tests/conftest.py write the shared metrics
 # registry's snapshot after the session (T1_METRICS_ARTIFACT, default

@@ -52,6 +52,12 @@ if _bb_artifact:
 # that smoke run's explicit =1 wins.
 os.environ.setdefault("DL4J_AUTO_MESH", "0")
 
+# Pallas interpret mode OFF for the suite, whatever the invoking shell
+# exported: on the CPU test backend the conv/BN kernel probes must refuse
+# the real kernel path (tests that want interpret-mode numerics flip
+# pcb._INTERPRET themselves via the module fixture, and restore it).
+os.environ["DL4J_PALLAS_INTERPRET"] = "0"
+
 # Device-profiler sampling OFF under tier-1 (utils/devprof): the sampled
 # block_until_ready would add timing jitter to every fit-heavy test on a
 # loaded CI box. Tests that exercise the sampler configure it locally

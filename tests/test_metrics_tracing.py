@@ -291,26 +291,29 @@ def test_helper_fallback_counters_on_auto_disable():
 
     helpers.register_helper(op, boom, name="boomer")
     try:
+        # no family= at registration: the kernel-family label defaults
+        # to the op name (bounded — one value per op)
         before_dis = _counter_value("helper_auto_disable_total",
-                                    op=op, helper="boomer")
+                                    op=op, helper="boomer", family=op)
         before_raised = _counter_value("helper_fallback_total",
-                                       op=op, helper="boomer",
+                                       op=op, helper="boomer", family=op,
                                        reason="raised")
         fn = helpers.get_helper(op)
         assert fn is not None
         assert _counter_value("helper_hit_total",
-                              op=op, helper="boomer") >= 1
+                              op=op, helper="boomer", family=op) >= 1
         with pytest.raises(helpers.HelperError):
             fn(1, 2)
         assert _counter_value("helper_auto_disable_total", op=op,
-                              helper="boomer") == before_dis + 1
+                              helper="boomer", family=op) == before_dis + 1
         assert _counter_value("helper_fallback_total", op=op,
-                              helper="boomer",
+                              helper="boomer", family=op,
                               reason="raised") == before_raised + 1
         # the helper is now disabled: the next lookup falls back, counted
         assert helpers.get_helper(op) is None
         assert _counter_value("helper_fallback_total", op=op,
-                              helper="boomer", reason="disabled") >= 1
+                              helper="boomer", family=op,
+                              reason="disabled") >= 1
     finally:
         helpers._HELPERS.pop(op, None)
 
@@ -321,13 +324,55 @@ def test_helper_unsupported_fallback_counted():
                             supported=lambda **ctx: False, name="picky")
     try:
         before = _counter_value("helper_fallback_total", op=op,
-                                helper="picky", reason="unsupported")
+                                helper="picky", family=op,
+                                reason="unsupported")
         assert helpers.get_helper(op) is None
         assert _counter_value("helper_fallback_total", op=op,
-                              helper="picky",
+                              helper="picky", family=op,
                               reason="unsupported") == before + 1
     finally:
         helpers._HELPERS.pop(op, None)
+
+
+def test_helper_counter_family_label_cardinality_bounded():
+    """The kernel-family label on helper_* counters must stay bounded:
+    one slug per kernel family, or the op name when the registration
+    carries no family fn — never a per-shape or per-instance value
+    (which would blow up the scrape cardinality)."""
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.ops import pallas_conv_bn  # noqa: F401 (registers)
+
+    # exercise several conv contexts so the conv family slugs materialize
+    # on the fallback counters (CPU: everything falls back, labeled)
+    for kernel, stride in (((1, 1), (1, 1)), ((3, 3), (1, 1)),
+                           ((3, 3), (2, 2)), ((7, 7), (2, 2)),
+                           ((5, 5), (1, 1))):
+        helpers.get_helper(
+            "conv2d", kernel=kernel, stride=stride, dilation=(1, 1),
+            same=True, has_bias=False, activation="identity",
+            dtype=jnp.float32, n_in=64, n_out=64,
+            x_shape=(2, 8, 8, 64), training=True)
+
+    allowed_slugs = {"conv1x1", "conv1x1s2", "conv3x3", "conv3x3s2",
+                     "conv7x7s2", "conv_other", "bn_apply", "bn_bwd",
+                     "lstm_seq", "lstm_step"}
+    reg = metrics_mod.get_registry()
+    seen = 0
+    for name in ("helper_hit_total", "helper_fallback_total",
+                 "helper_auto_disable_total"):
+        fam = reg.get(name)
+        if fam is None:
+            continue
+        assert "family" in fam.labelnames
+        f_idx = fam.labelnames.index("family")
+        op_idx = fam.labelnames.index("op")
+        for key in list(fam._children):
+            seen += 1
+            fam_label, op_label = key[f_idx], key[op_idx]
+            assert fam_label in allowed_slugs or fam_label == op_label, (
+                f"{name}: unbounded family label {fam_label!r} "
+                f"(op={op_label!r})")
+    assert seen > 0  # the probes above must have produced labeled samples
 
 
 # -- fit-loop wiring + overhead guard ----------------------------------------

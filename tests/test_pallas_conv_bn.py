@@ -58,6 +58,10 @@ def _ref_conv(x, w, strides):
         ((1, 1), (2, 2), 16, 8, 6),   # projection shortcut, even spatial
         ((1, 1), (2, 2), 8, 16, 7),   # SAME/odd spatial: ceil(7/2)=4 rows
         ((3, 3), (1, 1), 8, 8, 5),    # bottleneck middle conv
+        ((3, 3), (2, 2), 8, 8, 8),    # stage-entry 3x3/s2, even spatial
+        ((3, 3), (2, 2), 8, 8, 7),    # 3x3/s2 odd spatial: stride-2 halo
+        ((7, 7), (2, 2), 3, 8, 16),   # stem 7x7/s2, even spatial
+        ((7, 7), (2, 2), 3, 8, 9),    # stem 7x7/s2, odd: asymmetric SAME pad
     ],
 )
 def test_conv_stats_matches_xla_forward_and_grad(kernel, strides, cin, cout, hw):
@@ -176,6 +180,32 @@ def test_fused_op_f64_gradient_check():
                               max_rel_error=1e-5, verbose=True)
 
 
+@pytest.mark.parametrize("kernel,strides,hw", [
+    ((3, 3), (2, 2), 5),   # stage-entry stride, odd spatial halo
+    ((7, 7), (2, 2), 6),   # stem kernel: pad wider than the input edge
+])
+def test_strided_kernels_f64_gradient_check(kernel, strides, hw):
+    """f64 finite differences through the NEW strided kernels' VJP (the
+    transposed-conv pullback is stride-agnostic by construction — this
+    pins that claim numerically, per-tap slice plan included)."""
+    from deeplearning4j_tpu.train.gradientcheck import check_gradients_fn
+
+    rng = np.random.default_rng(7)
+    cin, cout, b = 2, 4, 2
+    x = rng.standard_normal((b, hw, hw, cin))
+    nw = kernel[0] * kernel[1] * cin * cout
+
+    def loss_of_flat(flat):
+        w = flat.reshape(*kernel, cin, cout)
+        xj = jnp.asarray(x, flat.dtype)
+        y, _, _ = pcb.conv2d_bn_stats(xj, w, strides)
+        return jnp.sum(y * jnp.cos(y))
+
+    flat0 = rng.standard_normal(nw) * 0.3
+    assert check_gradients_fn(loss_of_flat, flat0, epsilon=1e-6,
+                              max_rel_error=1e-5, verbose=True)
+
+
 # -- SPI integration ---------------------------------------------------------
 
 def _build_conv_bn_net(seed=5):
@@ -228,14 +258,85 @@ def test_network_uses_helpers_and_matches_builtin():
     net_h.fit(x, y, batch_size=8, epochs=2, async_prefetch=False)
     out_h = np.asarray(net_h.output(x))
 
-    for op in ("conv2d", "batch_norm"):
+    for op in ("conv2d", "batch_norm", "bn_backward"):
         set_helper_enabled(op, False)
     try:
         net_b = _build_conv_bn_net()
         net_b.fit(x, y, batch_size=8, epochs=2, async_prefetch=False)
         out_b = np.asarray(net_b.output(x))
     finally:
-        for op in ("conv2d", "batch_norm"):
+        for op in ("conv2d", "batch_norm", "bn_backward"):
+            set_helper_enabled(op, True)
+
+    np.testing.assert_allclose(out_h, out_b, rtol=3e-4, atol=3e-5)
+    for p1, p2 in zip(net_h.params_list, net_b.params_list):
+        for k in p1:
+            np.testing.assert_allclose(
+                np.asarray(p1[k]), np.asarray(p2[k]), rtol=3e-4, atol=3e-5,
+                err_msg=f"param {k}")
+    for s1, s2 in zip(net_h.state_list, net_b.state_list):
+        if s1 is not None:
+            for k in s1:
+                np.testing.assert_allclose(
+                    np.asarray(s1[k]), np.asarray(s2[k]), rtol=3e-4,
+                    atol=3e-5, err_msg=f"state {k}")
+
+
+def _build_stem_net(seed=11):
+    """A ResNet-stem-shaped graph: 7x7/s2 conv -> BN -> ReLU -> 3x3/s2
+    conv -> BN -> pool -> out, on odd 9x9 input so both strided kernels
+    exercise the asymmetric-SAME halo path end to end."""
+    from deeplearning4j_tpu.nn.compgraph import ComputationGraph
+    from deeplearning4j_tpu.nn.conf import (
+        ActivationLayer,
+        BatchNormalization,
+        ConvolutionLayer,
+        GlobalPoolingLayer,
+        InputType,
+        NeuralNetConfiguration,
+        OutputLayer,
+    )
+
+    gb = (NeuralNetConfiguration.builder().seed(seed).learning_rate(0.05)
+          .weight_init("relu").graph_builder().add_inputs("input")
+          .set_input_types(InputType.convolutional(9, 9, 3)))
+    gb.add_layer("stem", ConvolutionLayer(
+        kernel_size=(7, 7), stride=(2, 2), n_out=8, convolution_mode="same",
+        has_bias=False, activation="identity"), "input")
+    gb.add_layer("bn1", BatchNormalization(), "stem")
+    gb.add_layer("r1", ActivationLayer(activation="relu"), "bn1")
+    gb.add_layer("entry", ConvolutionLayer(
+        kernel_size=(3, 3), stride=(2, 2), n_out=16, convolution_mode="same",
+        has_bias=False, activation="identity"), "r1")
+    gb.add_layer("bn2", BatchNormalization(), "entry")
+    gb.add_layer("pool", GlobalPoolingLayer(pooling_type="avg"), "bn2")
+    gb.add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                    loss="mcxent"), "pool")
+    gb.set_outputs("out")
+    return ComputationGraph(gb.build()).init()
+
+
+def test_stem_network_helpers_match_builtin():
+    """End to end with the NEW kernels (7x7/s2 stem + 3x3/s2 stage entry):
+    helpers-on training equals builtin-XLA training — outputs, params and
+    the BN running statistics."""
+    rng = np.random.default_rng(13)
+    x = rng.standard_normal((8, 9, 9, 3)).astype(np.float32)
+    y = np.zeros((8, 3), np.float32)
+    y[np.arange(8), rng.integers(0, 3, 8)] = 1.0
+
+    net_h = _build_stem_net()
+    net_h.fit(x, y, batch_size=8, epochs=2, async_prefetch=False)
+    out_h = np.asarray(net_h.output(x))
+
+    for op in ("conv2d", "batch_norm", "bn_backward"):
+        set_helper_enabled(op, False)
+    try:
+        net_b = _build_stem_net()
+        net_b.fit(x, y, batch_size=8, epochs=2, async_prefetch=False)
+        out_b = np.asarray(net_b.output(x))
+    finally:
+        for op in ("conv2d", "batch_norm", "bn_backward"):
             set_helper_enabled(op, True)
 
     np.testing.assert_allclose(out_h, out_b, rtol=3e-4, atol=3e-5)
@@ -261,10 +362,18 @@ def test_helpers_registered_and_probed():
                 has_bias=False, activation="identity", dtype=jnp.float32,
                 n_in=8, n_out=16, x_shape=(2, 6, 6, 8), training=True)
     assert get_helper("conv2d", **base) is not None
+    # the full covered family, stem + stage-entry strided shapes included
+    for good in (dict(kernel=(1, 1), stride=(2, 2)),
+                 dict(kernel=(3, 3), stride=(1, 1)),
+                 dict(kernel=(3, 3), stride=(2, 2)),  # stage-entry 3x3/s2
+                 dict(kernel=(7, 7), stride=(2, 2), n_in=3,
+                      x_shape=(2, 6, 6, 3))):         # stem
+        ctx = dict(base)
+        ctx.update(good)
+        assert get_helper("conv2d", **ctx) is not None, good
     # fallback whitelist: everything a ResNet trunk conv is NOT
-    for bad in (dict(kernel=(7, 7), stride=(2, 2)),   # stem
-                dict(kernel=(3, 3), stride=(2, 2)),   # stage-entry 3x3/s2
-                dict(kernel=(5, 5)),
+    for bad in (dict(kernel=(5, 5)),
+                dict(kernel=(7, 7), stride=(1, 1)),
                 dict(has_bias=True),
                 dict(activation="relu"),
                 dict(dilation=(2, 2)),
@@ -273,6 +382,93 @@ def test_helpers_registered_and_probed():
         ctx = dict(base)
         ctx.update(bad)
         assert get_helper("conv2d", **ctx) is None, bad
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_bn_backward_fused_matches_builtin_reductions(dtype):
+    """The fused bn_backward helper (one Pallas pass over g and x for
+    dgamma/dbeta + one for dx) == the builtin jnp reductions it replaces,
+    for both the f32 (raw x, center=mean) and bf16 (centered x,
+    center=delta) recenterings of `_bn_backward_pieces`."""
+    rng = np.random.default_rng(17)
+    c, n_shape = 8, (4, 5, 5, 8)
+    x = jnp.asarray(rng.standard_normal(n_shape) * 1.2 + 0.3, dtype)
+    g = jnp.asarray(rng.standard_normal(n_shape), dtype)
+    gamma = jnp.asarray(rng.standard_normal(c) * 0.2 + 1.0, jnp.float32)
+    n = x.size // c
+    xf = np.asarray(x, np.float64).reshape(-1, c)
+    mean = jnp.asarray(xf.mean(0), jnp.float32)
+    var = jnp.asarray(xf.var(0), jnp.float32)
+    inv = lax.rsqrt(var + 1e-5)
+
+    dx_h, dg_h, db_h = pcb._bn_backward_pieces(g, x, mean, inv, gamma, n)
+    set_helper_enabled("bn_backward", False)
+    try:
+        dx_b, dg_b, db_b = pcb._bn_backward_pieces(g, x, mean, inv, gamma, n)
+    finally:
+        set_helper_enabled("bn_backward", True)
+
+    # bf16: the kernel casts g and x to f32 BEFORE the product; the
+    # builtin reduction multiplies in bf16 first (`_col_sums(g2 * x2)`).
+    # The kernel is the more accurate of the two — the comparison
+    # tolerance is the bf16 product-rounding bound, not a kernel defect.
+    tol = 2e-2 if dtype == jnp.bfloat16 else 1e-5
+    np.testing.assert_allclose(np.asarray(dx_h, np.float32),
+                               np.asarray(dx_b, np.float32),
+                               rtol=tol, atol=tol, err_msg="dx")
+    for a, b, name in ((dg_h, dg_b, "dgamma"), (db_h, db_b, "dbeta")):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=tol, atol=tol, err_msg=name)
+
+
+def test_roofline_declines_compute_bound_conv():
+    """The economic stage of `conv_decision`: a stage-3-like 3x3 conv is
+    compute-bound on the modeled roofline (intensity above the ridge) and
+    must be DECLINED — the stats epilogue saves an HBM read worth nothing
+    there, so a compute-bound shape can never regress through the helper.
+    The same kernel family on a memory-bound instance stays covered."""
+    big = dict(kernel=(3, 3), stride=(1, 1), dilation=(1, 1), same=True,
+               has_bias=False, activation="identity", dtype=jnp.bfloat16,
+               n_in=256, n_out=256, x_shape=(8, 14, 14, 256), training=True)
+    d = pcb.conv_decision(**big)
+    assert d["status"] == "declined"
+    assert d["reason"] == "compute_bound"
+    assert d["roofline"]["intensity"] > d["roofline"]["ridge_intensity"]
+    assert d["family"] == "conv3x3"
+    assert get_helper("conv2d", **big) is None
+
+    small = dict(big, dtype=jnp.float32, n_in=8, n_out=8,
+                 x_shape=(2, 6, 6, 8))
+    ds = pcb.conv_decision(**small)
+    assert ds["status"] == "covered"
+    assert ds["reason"] == "memory_bound"
+
+
+def test_resnet50_kernel_coverage_complete():
+    """The 53/53 contract: every ResNet-50 conv instance resolves to
+    covered or declined-with-roofline-verdict — zero silently-unsupported
+    shapes (the gap this kernel family closes)."""
+    from deeplearning4j_tpu.analysis.kernelcoverage import (
+        coverage_summary,
+        coverage_table,
+    )
+    from deeplearning4j_tpu.models.resnet import resnet50_conf
+
+    rows = coverage_table(resnet50_conf(), batch=128)
+    s = coverage_summary(rows)
+    assert s["total"] == 53
+    assert s["unsupported"] == 0
+    assert s["covered"] + s["declined"] == 53
+    assert s["covered"] > 0 and s["declined"] > 0
+    by = {r["layer"]: r for r in rows}
+    assert by["stem_conv"]["status"] == "covered"
+    assert by["stem_conv"]["family"] == "conv7x7s2"
+    assert by["s1b0_b_conv"]["status"] == "covered"   # 3x3/s2 stage entry
+    assert by["s1b0_b_conv"]["family"] == "conv3x3s2"
+    for r in rows:
+        if r["status"] == "declined":
+            assert r["reason"] == "compute_bound"
+            assert r["intensity"] > r["ridge"]
 
 
 def test_fallback_on_cpu_without_interpret():
@@ -367,6 +563,47 @@ def test_raising_helper_fn_disables_and_falls_back(caplog):
     finally:
         pcb.register()  # restore the real kernels (fresh enabled Helper)
     assert helper_names()["conv2d"] == "pallas_conv_bn_stats"
+
+
+def test_raising_bn_backward_helper_disables_and_falls_back(caplog):
+    """The SPI auto-disable contract for the NEW "bn_backward" slot: a
+    fused-backward fn that raises at trace time is caught, logged and
+    disabled, and both consumers (`norm.py _bn_train_bwd` and the pallas
+    `_bn_bwd`) retry their builtin reductions — the network trains to the
+    same parameters as the fully-builtin run."""
+
+    def exploding(*a, **k):
+        raise ValueError("synthetic bn-backward lowering failure")
+
+    x, y = _train_data()
+    register_helper("bn_backward", exploding, lambda **ctx: True,
+                    name="exploding_bn_bwd")
+    try:
+        with caplog.at_level(logging.WARNING, logger="deeplearning4j_tpu"):
+            net = _build_conv_bn_net()
+            net.fit(x, y, batch_size=8, epochs=1, async_prefetch=False)
+        assert any("exploding_bn_bwd" in r.message and "disabled" in r.message
+                   for r in caplog.records)
+        assert helper_names()["bn_backward"] == "exploding_bn_bwd"
+        # disabled => probe-level refusal now, without calling fn
+        assert get_helper("bn_backward", anything=1) is None
+
+        for op in ("conv2d", "batch_norm", "bn_backward"):
+            set_helper_enabled(op, False)
+        try:
+            net_b = _build_conv_bn_net()
+            net_b.fit(x, y, batch_size=8, epochs=1, async_prefetch=False)
+        finally:
+            for op in ("conv2d", "batch_norm"):
+                set_helper_enabled(op, True)
+        for p1, p2 in zip(net.params_list, net_b.params_list):
+            for k in p1:
+                np.testing.assert_allclose(
+                    np.asarray(p1[k]), np.asarray(p2[k]),
+                    rtol=3e-4, atol=3e-5, err_msg=f"param {k}")
+    finally:
+        pcb.register()  # restore the real kernels (fresh enabled Helper)
+    assert helper_names()["bn_backward"] == "pallas_fused_bn_bwd"
 
 
 def test_guarded_helper_raises_helper_error_directly():
