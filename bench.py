@@ -654,7 +654,15 @@ def bench_parallel_inference_overload(duration=3.0, n_in=64, hidden=64,
         RequestRejected,
     )
     from deeplearning4j_tpu.utils import health as _health
+    from deeplearning4j_tpu.utils import resourcemeter
     from deeplearning4j_tpu.utils.latency import LatencyTracker
+    from deeplearning4j_tpu.utils.metrics import get_registry
+
+    # two tenants ride the overload so the shed/books verdict is
+    # per-customer, not just aggregate; metering attributes the forward
+    # device time each tenant actually got
+    resourcemeter.enable()
+    tenants = ("gold", "free")
 
     on_tpu = jax.default_backend() not in ("cpu",)
     # queue_capacity=None → per-backend preset: a small CPU box needs a
@@ -707,7 +715,11 @@ def bench_parallel_inference_overload(duration=3.0, n_in=64, hidden=64,
                      "queue_capacity": queue_capacity,
                      "handoff_capacity": 1,
                      "component": "bench_overload"},
-            sample_every=max(0.25, duration / 8.0)))
+            sample_every=max(0.25, duration / 8.0),
+            # per-tenant chip-budget burn rules ride the same ledger; a
+            # whole chip per tenant is a generous bar this single-host
+            # soak must stay under
+            tenants={t: 1.0 for t in tenants}))
     _runledger.attach(ledger)
     rng = np.random.default_rng(0)
     reqs = [rng.standard_normal((1, n_in)).astype(np.float32)
@@ -724,7 +736,8 @@ def bench_parallel_inference_overload(duration=3.0, n_in=64, hidden=64,
                 j += 1
                 t0 = time.perf_counter()
                 try:
-                    pi.output(reqs[(i * 31 + j) % len(reqs)])
+                    pi.output(reqs[(i * 31 + j) % len(reqs)],
+                              tenant=tenants[i % len(tenants)])
                     if track:
                         lat.record(time.perf_counter() - t0)
                 except (DeadlineExceeded, RequestRejected) as e:
@@ -763,11 +776,15 @@ def bench_parallel_inference_overload(duration=3.0, n_in=64, hidden=64,
                               "rejected", "requests")}
 
     # phase 1: measured capacity — few clients, nothing sheds
+    spend0 = resourcemeter.spend_table(get_registry().scalar_values())
     base_dt, base = run_phase(4, duration * 0.5, track=False)
     # phase 2: ~2x the absorbable outstanding work, shedding expected
     max_depth[0] = 0
     over_dt, over = run_phase(clients, duration, track=True)
     m = pi.metrics()
+    spend1 = resourcemeter.spend_table(get_registry().scalar_values())
+    tenant_cons = resourcemeter.conservation(
+        get_registry().scalar_values())
     comps = _health.get_health().status()["components"]
     stalled = [k for k, v in comps.items()
                if k.startswith("bench_overload")
@@ -785,6 +802,15 @@ def bench_parallel_inference_overload(duration=3.0, n_in=64, hidden=64,
         # the books MUST balance — a leak here is a correctness bug, not
         # a perf number
         raise RuntimeError(f"conservation violated: {m}")
+    bad_tenants = {t: b for t, b in m["tenants"].items()
+                   if not b["conservation_ok"]}
+    if bad_tenants or not tenant_cons["ok"]:
+        # the PER-TENANT law and the spend sum-to-process-total check:
+        # aggregate books can balance while one tenant leaks into
+        # another — multi-tenant hosting is graded on the exact split
+        raise RuntimeError(
+            f"per-tenant conservation violated: books={bad_tenants} "
+            f"spend={tenant_cons}")
     snap = lat.snapshot()
     capacity_rps = base["completed"] / base_dt
     offered = (over["requests"] or 1) / over_dt
@@ -819,6 +845,17 @@ def bench_parallel_inference_overload(duration=3.0, n_in=64, hidden=64,
             "fired_errors": slo_fired_errors,
         },
         "slo_ok": not slo_fired_errors,
+        # per-tenant half of the verdict: exact books per customer plus
+        # the serving device-seconds each one actually received
+        "tenants": m["tenants"],
+        "tenant_spend": {
+            t: round(
+                spend1.get(t, {}).get("device_seconds", {}).get(
+                    resourcemeter.TIER_SERVING, 0.0)
+                - spend0.get(t, {}).get("device_seconds", {}).get(
+                    resourcemeter.TIER_SERVING, 0.0), 4)
+            for t in tenants},
+        "tenant_conservation": tenant_cons,
     }
 
 
@@ -842,7 +879,17 @@ def bench_decode(n_slots=8, duration=6.0, vocab=32, hidden=64,
 
     from deeplearning4j_tpu.models.charlstm import char_lstm_network
     from deeplearning4j_tpu.serving.decode import DecodeEngine
+    from deeplearning4j_tpu.utils import resourcemeter
     from deeplearning4j_tpu.utils.latency import LatencyTracker
+    from deeplearning4j_tpu.utils.metrics import get_registry
+
+    # arm tenant spend metering: the verdict embeds per-tenant
+    # device-seconds, and the fairness probe judges the split
+    resourcemeter.enable()
+
+    def _dec_sec(table, tenant):
+        return table.get(tenant, {}).get(
+            "device_seconds", {}).get(resourcemeter.TIER_DECODE, 0.0)
 
     on_tpu = jax.default_backend() not in ("cpu",)
     if slo_ms is None:
@@ -905,7 +952,26 @@ def bench_decode(n_slots=8, duration=6.0, vocab=32, hidden=64,
     # warmup: compile the step + reset programs before the clock starts
     engine.generate([1, 2, 3], max_new_tokens=2, tenant="gold").result(120)
     warm_cache = engine.program_cache_size()
+    # the soak ledger: per-tenant spend series recorded like any other,
+    # with the per-tenant chip-budget burn rules judged live (a whole
+    # chip per tenant is the generous single-host bar). Attached AFTER
+    # warmup so the rules only grade traffic.
+    import tempfile
+
+    from deeplearning4j_tpu.analysis.slo import default_rule_pack
+    from deeplearning4j_tpu.utils import runledger as _runledger
+
+    ledger_path = os.path.join(tempfile.gettempdir(),
+                               f"BENCH_decode_ledger_{os.getpid()}.jsonl")
+    se = max(0.25, duration / 8.0)
+    ledger = _runledger.RunLedger(
+        ledger_path, sample_every=se,
+        rules=default_rule_pack(
+            sample_every=se,
+            tenants={"gold": 1.0, "std": 1.0}))
+    _runledger.attach(ledger)
     before = engine.metrics()
+    spend0 = resourcemeter.spend_table(get_registry().scalar_values())
     clients = n_slots + 2  # keep the pool saturated, the queue shallow
     threads = [threading.Thread(target=client, args=(i,), daemon=True,
                                 name=f"dl4j-bench-dec-{i}")
@@ -935,6 +1001,12 @@ def bench_decode(n_slots=8, duration=6.0, vocab=32, hidden=64,
     dt = time.perf_counter() - t0
     after = engine.metrics()
     final_cache = engine.program_cache_size()
+    spend1 = resourcemeter.spend_table(get_registry().scalar_values())
+    # close (final sample) BEFORE the verdict: the replayable artifact
+    # must hold everything the live verdict judged
+    ledger.close()
+    slo_fired = ledger.rules.ever_fired()
+    slo_fired_errors = ledger.rules.ever_fired("error")
     engine.shutdown()
     if client_errors:
         raise RuntimeError(f"decode client died: {client_errors[:3]}")
@@ -1026,6 +1098,70 @@ def bench_decode(n_slots=8, duration=6.0, vocab=32, hidden=64,
                 "itl_p50_ms": snap["p50_ms"],
                 "itl_p99_ms": snap["p99_ms"]}
 
+    # -- weighted-fair spend probe: both tenants fully backlogged ------------
+    def fairness_probe(secs=2.5):
+        """The main soak's clients pick a tenant per request, so neither
+        tenant stays backlogged and stride scheduling has nothing to
+        arbitrate. Here each tenant keeps n_slots clients outstanding on
+        a fresh engine — under dual backlog the 3:1 weights must show up
+        as a ~3:1 decode device-seconds split in the resource meter."""
+        eng = DecodeEngine(net, n_slots=n_slots,
+                           tenant_weights={"gold": 3.0, "std": 1.0},
+                           default_max_tokens=32, queue_capacity=256,
+                           component_prefix="bench_decode_fair")
+        errs = []
+        try:
+            eng.generate([1, 2, 3], max_new_tokens=2,
+                         tenant="gold").result(120)
+            s0 = resourcemeter.spend_table(get_registry().scalar_values())
+            stop_f = threading.Event()
+
+            def fclient(tenant, ci):
+                j = 0
+                try:
+                    while not stop_f.is_set():
+                        j += 1
+                        prompt, n_new, _ = make_req(90_000 + ci * 7919 + j)
+                        eng.generate(prompt, max_new_tokens=n_new,
+                                     tenant=tenant).result(timeout=120)
+                except BaseException as e:  # noqa: BLE001 - reported
+                    errs.append(f"{type(e).__name__}: {e}")
+
+            ths = [threading.Thread(target=fclient, args=(ten, i),
+                                    daemon=True,
+                                    name=f"dl4j-bench-fair-{ten}-{i}")
+                   for ten in ("gold", "std") for i in range(n_slots)]
+            for th in ths:
+                th.start()
+            time.sleep(secs)
+            stop_f.set()
+            for th in ths:
+                th.join(timeout=60.0)
+        finally:
+            eng.shutdown()
+        if errs:
+            raise RuntimeError(f"fairness client died: {errs[:3]}")
+        s1 = resourcemeter.spend_table(get_registry().scalar_values())
+        gold = _dec_sec(s1, "gold") - _dec_sec(s0, "gold")
+        std = _dec_sec(s1, "std") - _dec_sec(s0, "std")
+        ratio = gold / max(std, 1e-9)
+        want = 3.0  # the engine's gold:std weight ratio
+        return {
+            "device_seconds": {"gold": round(gold, 4),
+                               "std": round(std, 4)},
+            "ratio": round(ratio, 2),
+            "want_ratio": want,
+            # generous 2x band: stride scheduling is exact on admissions
+            # but request lengths are zipf, so spend only approximates it
+            "ok": bool(std > 0 and want / 2 <= ratio <= want * 2),
+        }
+
+    fair = fairness_probe()
+    if not fair["ok"]:
+        raise RuntimeError(
+            f"weighted-fair spend violated: gold:std device-seconds "
+            f"ratio {fair['ratio']} (want ~{fair['want_ratio']}): {fair}")
+
     fused_k = 4
     f_base = fused_probe(1)
     f_fused = fused_probe(fused_k)
@@ -1074,6 +1210,27 @@ def bench_decode(n_slots=8, duration=6.0, vocab=32, hidden=64,
         "books": {k: after[k] for k in ("admitted", "completed", "shed",
                                         "failed", "rejected")},
         "tenants": after["tenants"],
+        # per-tenant chip spend over the soak (utils/resourcemeter) and
+        # the dual-backlog weighted-fair verdict
+        "tenant_spend": {
+            t: {"decode_device_seconds":
+                round(_dec_sec(spend1, t) - _dec_sec(spend0, t), 4),
+                "tokens": round(
+                    spend1.get(t, {}).get("tokens", 0.0)
+                    - spend0.get(t, {}).get("tokens", 0.0))}
+            for t in ("gold", "std")},
+        "weighted_fair": fair,
+        # the recorded half: per-tenant series + burn rules in a
+        # replayable artifact (cli tenants --ledger <path> reproduces
+        # tenant_spend; cli slo --ledger <path> --check re-judges it)
+        "slo": {
+            "ledger": ledger_path,
+            "run_id": ledger.run_id,
+            "rules": [r.name for r in ledger.rules.rules],
+            "fired": slo_fired,
+            "fired_errors": slo_fired_errors,
+        },
+        "slo_ok": not slo_fired_errors,
         "vs_alternate": {
             "alternate": "naive_per_request_rnn_time_step_loop",
             "alternate_tokens_per_sec": round(naive_tps, 1),

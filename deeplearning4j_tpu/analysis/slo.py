@@ -426,9 +426,34 @@ class SLORuleSet:
 
 # -- the default rule pack -----------------------------------------------------
 
+def tenant_burn_rules(tenants: Dict[str, float],
+                      sample_every: float = 5.0,
+                      severity: str = WARNING) -> List[SLORule]:
+    """Per-tenant chip-budget burn rules over the resource meter's
+    `tenant_device_seconds_total{tenant,tier}` series (utils/
+    resourcemeter). `tenants` maps tenant name -> its device-seconds-
+    per-wall-second allowance; the rule judges each tier's spend rate
+    separately and fires on the worst one (a tenant burning device time
+    in ANY tier faster than its share — 1.0/s is a whole chip). A
+    tenant that never spends matches nothing and never alerts, so the
+    pack is safe to attach before traffic arrives."""
+    debounce = max(0.0, 2.0 * float(sample_every))
+    return [SLORule(
+        name=f"tenant_chip_budget_burn:{tenant}",
+        kind="rate_of_change",
+        series=f'tenant_device_seconds_total{{tenant="{tenant}"}}',
+        op=">", value=float(budget),
+        severity=severity,
+        component=f"tenant:{tenant}",
+        for_seconds=debounce,
+    ) for tenant, budget in sorted(tenants.items())]
+
+
 def default_rule_pack(cost_model=None, serving: Optional[dict] = None,
                       sample_every: float = 5.0,
-                      grad_norm_rate: float = 10.0) -> List[SLORule]:
+                      grad_norm_rate: float = 10.0,
+                      tenants: Optional[Dict[str, float]] = None
+                      ) -> List[SLORule]:
     """Standing rules derived from what this process attached:
 
     * serving (dict with `default_deadline_ms` / `queue_capacity` /
@@ -439,6 +464,10 @@ def default_rule_pack(cost_model=None, serving: Optional[dict] = None,
       gap is tuning signal, not an outage) and
       `device_memory_bytes{kind="live"}` above 90% of the JX008
       residency budget (error; only on backends that report HBM).
+    * tenants (dict tenant -> device-seconds/s allowance): one
+      per-tenant chip-budget burn rule each (tenant_burn_rules) —
+      a tenant outspending its share of the chips turns from a number
+      in GET /tenants into a debounced firing state.
     * always: any OOM reaching the forensics path is an error, and the
       sentinel's `train_grad_norm` gauge growing faster than
       `grad_norm_rate`/s is a WARNING — the divergence *precursor*: the
@@ -535,6 +564,8 @@ def default_rule_pack(cost_model=None, serving: Optional[dict] = None,
                 component="device",
                 for_seconds=debounce,
             ))
+    if tenants:
+        rules.extend(tenant_burn_rules(tenants, sample_every=sample_every))
     return rules
 
 

@@ -669,6 +669,95 @@ def _metrics_replay(args) -> int:
     return 0
 
 
+def cmd_tenants(args) -> int:
+    """Per-tenant chip-budget readout (utils/resourcemeter). Three
+    sources, one rendering: no flags shows THIS process's spend+books,
+    --url asks a running server's GET /tenants, --ledger rebuilds the
+    spend table offline from a recorded run's final sample — all three
+    parse the same flat scalar-values vocabulary through
+    resourcemeter.spend_table(), so live and replay agree by
+    construction."""
+    import json as _json
+
+    from deeplearning4j_tpu.utils import resourcemeter
+
+    if getattr(args, "ledger", None):
+        import os
+
+        from deeplearning4j_tpu.utils import runledger
+
+        if not os.path.exists(args.ledger):
+            print(f"ledger not found: {args.ledger}", file=sys.stderr)
+            return 2
+        led = runledger.read_ledger(args.ledger)
+        values: dict = {}
+        for _ts, sample in runledger.iter_samples(led):
+            values = sample  # the run's final recorded sample wins
+        doc = {
+            "tenants": resourcemeter.spend_table(values),
+            # offline there are no live book-keepers: spend conservation
+            # is judged for real, books vacuously
+            "conservation": resourcemeter.conservation(values, books={}),
+            "source": (f"ledger {args.ledger} "
+                       f"(run {led['manifest'].get('run_id')})"),
+        }
+    elif args.url:
+        import urllib.request
+
+        with urllib.request.urlopen(args.url.rstrip("/") + "/tenants",
+                                    timeout=args.timeout) as r:
+            doc = _json.loads(r.read().decode())
+        doc["source"] = args.url
+    else:
+        doc = resourcemeter.snapshot()
+        doc["source"] = "in-process"
+    if args.json:
+        print(_json.dumps(doc, indent=2, default=str))
+        return 0
+    print(f"tenants — {doc.get('source', '')}")
+    tenants = doc.get("tenants") or {}
+    if not tenants:
+        print("  (no tenant has been admitted or metered yet)")
+    for t in sorted(tenants):
+        rec = tenants[t] or {}
+        parts = []
+        dev = rec.get("device_seconds") or {}
+        if dev:
+            parts.append("dev[s] " + " ".join(
+                f"{tier}={s:.4g}" for tier, s in sorted(dev.items())))
+        wire = rec.get("wire_bytes") or {}
+        if wire:
+            parts.append("wire[B] " + " ".join(
+                f"{tier}={int(b)}" for tier, b in sorted(wire.items())))
+        if rec.get("tokens"):
+            parts.append(f"tokens {int(rec['tokens'])}")
+        if rec.get("examples"):
+            parts.append(f"examples {int(rec['examples'])}")
+        if rec.get("hbm_bytes"):
+            parts.append(f"hbm[B] {int(rec['hbm_bytes'])}")
+        books = rec.get("books")
+        if books:
+            ok = "" if books.get("conservation_ok", True) else " !LEAK"
+            parts.append(
+                f"books adm={books.get('admitted', 0)} "
+                f"done={books.get('completed', 0)} "
+                f"shed={books.get('shed', 0)} "
+                f"fail={books.get('failed', 0)} "
+                f"rej={books.get('rejected', 0)}{ok}")
+        print(f"  {t:<16} " + ("  ".join(parts) if parts else "(idle)"))
+    cons = doc.get("conservation") or {}
+    if cons:
+        print(f"  conservation: books_ok={cons.get('books_ok')} "
+              f"spend_ok={cons.get('spend_ok')} ok={cons.get('ok')}")
+    firing = doc.get("slo_firing")
+    if firing:
+        print(f"  !! per-tenant SLO firing: "
+              f"{', '.join(str(r) for r in firing)}")
+    if doc.get("note"):
+        print(f"  note: {doc['note']}")
+    return 0
+
+
 def cmd_slo(args) -> int:
     """Offline SLO re-evaluation of a recorded run ledger
     (utils/runledger + analysis/slo): replay the sample stream through
@@ -1107,11 +1196,12 @@ def _chaos_unhealthy(wait: float = 10.0) -> list:
 
 def _chaos_serving(plan, requests: int, clients: int,
                    deadline_ms) -> dict:
-    """Serving preset: concurrent closed-loop clients against one
-    ParallelInference under the plan. Invariants checked: every client
-    terminates inside the budget, the books balance
-    (admitted == completed + shed + failed), and the serving components
-    end healthy."""
+    """Serving preset: concurrent closed-loop clients (two tenants)
+    against one ParallelInference under the plan. Invariants checked:
+    every client terminates inside the budget, the books balance
+    (admitted == completed + shed + failed) PER TENANT as well as in
+    aggregate, metered device-seconds sum to the process total, and the
+    serving components end healthy."""
     import threading
 
     import numpy as np
@@ -1122,7 +1212,9 @@ def _chaos_serving(plan, requests: int, clients: int,
         RequestRejected,
     )
     from deeplearning4j_tpu.utils import faultpoints as fp
+    from deeplearning4j_tpu.utils import resourcemeter
 
+    resourcemeter.enable()  # spend conservation judged non-vacuously
     n_in = 8
     net = _chaos_net(n_in)
     pi = ParallelInference(net, max_batch_size=4, batch_timeout_ms=2.0,
@@ -1139,7 +1231,8 @@ def _chaos_serving(plan, requests: int, clients: int,
         for j in range(per):
             try:
                 pi.output(reqs[(ci * 7 + j) % len(reqs)],
-                          deadline_ms=deadline_ms)
+                          deadline_ms=deadline_ms,
+                          tenant="a" if ci % 2 else "b")
                 k = "ok"
             except fp.FaultInjected:
                 k = "fault"
@@ -1174,16 +1267,30 @@ def _chaos_serving(plan, requests: int, clients: int,
                     wedged.append(t.name)
         m = pi.metrics()
         unhealthy = _chaos_unhealthy()
+        from deeplearning4j_tpu.utils.metrics import get_registry
+
+        spend_cons = resourcemeter.conservation(
+            get_registry().scalar_values())
     finally:
         pi.shutdown()
+    # the per-tenant law, non-vacuously: every tenant the workload
+    # assigned must actually appear in the books ("a" and "b" alternate
+    # by client index — a single-client run only ever offers one)
+    offered = {"a" if ci % 2 else "b" for ci in range(clients)}
+    tenant_books_ok = (
+        offered <= set(m["tenants"])
+        and all(b["conservation_ok"] for b in m["tenants"].values()))
     return {
         "workload": {"requests": per * clients, "clients": clients,
                      "deadline_ms": deadline_ms, "outcomes": counts},
         "metrics": {k: m[k] for k in ("admitted", "completed", "shed",
                                       "failed", "rejected")},
         "shed_by": m["shed_by"],
+        "tenants": m["tenants"],
+        "tenant_conservation": spend_cons,
         "conservation_ok":
-            m["admitted"] == m["completed"] + m["shed"] + m["failed"],
+            m["admitted"] == m["completed"] + m["shed"] + m["failed"]
+            and tenant_books_ok and spend_cons["ok"],
         "wedged_threads": wedged,
         "unhealthy_components": unhealthy,
         "outcome": "wedged" if wedged else "recovered",
@@ -1276,7 +1383,9 @@ def _chaos_decode(plan, requests: int, clients: int,
     from deeplearning4j_tpu.serving.decode import DecodeEngine
     from deeplearning4j_tpu.utils import faultpoints as fp
     from deeplearning4j_tpu.utils import health as _health
+    from deeplearning4j_tpu.utils import resourcemeter
 
+    resourcemeter.enable()  # spend conservation judged non-vacuously
     vocab = 11
     net = char_lstm_network(vocab_size=vocab, hidden=16, layers=1,
                             tbptt_length=8)
@@ -1326,6 +1435,10 @@ def _chaos_decode(plan, requests: int, clients: int,
                     wedged.append(t.name)
         m = eng.metrics()
         unhealthy = _chaos_unhealthy()
+        from deeplearning4j_tpu.utils.metrics import get_registry
+
+        spend_cons = resourcemeter.conservation(
+            get_registry().scalar_values())
         tripped = [
             tr for tr in _health.get_health().transitions_since(health_seq0)
             if str(tr.get("component", "")).startswith("chaos_decode")
@@ -1339,7 +1452,10 @@ def _chaos_decode(plan, requests: int, clients: int,
                                       "failed", "rejected")},
         "shed_by": m["shed_by"],
         "tenants": m["tenants"],
-        "conservation_ok": m["conservation_ok"],
+        "tenant_conservation": spend_cons,
+        "conservation_ok": (m["conservation_ok"]
+                            and {"a", "b"} <= set(m["tenants"])
+                            and spend_cons["ok"]),
         "watchdog_tripped": bool(tripped),
         "sheds_during_wedge": m["shed"],
         # the gate must not be vacuous: the injected hang must have
@@ -1763,6 +1879,25 @@ def main(argv=None) -> int:
                         "without the process alive); --watch-count caps "
                         "the ticks")
     m.set_defaults(fn=cmd_metrics)
+
+    tn = sub.add_parser(
+        "tenants",
+        help="per-tenant chip-budget readout: device-seconds by tier, "
+             "wire/HBM bytes, tokens, admission books, conservation "
+             "(utils/resourcemeter) — in-process, from a server's "
+             "GET /tenants, or replayed from a run ledger")
+    tn.add_argument("--url", default=None,
+                    help="base URL of a running inference server (its "
+                         "GET /tenants is appended; omit for the local "
+                         "process view)")
+    tn.add_argument("--ledger", default=None, metavar="PATH",
+                    help="rebuild the spend table from a recorded run "
+                         "ledger's final sample instead of a live "
+                         "process (same parse as the live view)")
+    tn.add_argument("--timeout", type=float, default=10.0)
+    tn.add_argument("--json", action="store_true",
+                    help="print the raw document instead of rendering")
+    tn.set_defaults(fn=cmd_tenants)
 
     sl = sub.add_parser(
         "slo",

@@ -30,6 +30,7 @@ from deeplearning4j_tpu.utils import devprof as _devprof
 from deeplearning4j_tpu.utils import faultpoints as _faults
 from deeplearning4j_tpu.utils import health as _health
 from deeplearning4j_tpu.utils import metrics as _metrics
+from deeplearning4j_tpu.utils import resourcemeter as _resourcemeter
 from deeplearning4j_tpu.utils import runledger as _runledger
 from deeplearning4j_tpu.utils import tracing as _tracing
 from deeplearning4j_tpu.train import sentinel as _sentinel
@@ -342,6 +343,16 @@ class NetworkBase:
         }
         return self
 
+    def set_tenant(self, tenant):
+        """Register this net under a tenant identity — the SAME identity
+        the serving tier books under (utils/tenancy). When process-wide
+        metering is enabled (utils/resourcemeter), the net's devprof
+        device-time windows, HBM residency, and all-reduce wire bytes
+        are attributed to that tenant; unmetered, this is just an
+        interned attribute."""
+        _resourcemeter.register_net(self, tenant)
+        return self
+
     # -- static analysis -----------------------------------------------------
 
     def doctor(self, *, batch_size: int = 2, timesteps: int = 8,
@@ -596,7 +607,12 @@ class NetworkBase:
         # runs on the interconnect, not through host averaging
         plan = self._mesh_plan
         if plan is not None and plan.n_data_shards > 1:
-            ins["allreduce_bytes"].inc(plan.grad_payload_bytes(self) * n_steps)
+            payload = plan.grad_payload_bytes(self) * n_steps
+            ins["allreduce_bytes"].inc(payload)
+            # tenant wire-bytes attribution for the same payload (a net
+            # registered via set_tenant; one global read unmetered)
+            _resourcemeter.note_wire(getattr(self, "_tenant", None),
+                                     _resourcemeter.TIER_TRAINING, payload)
             ins["collective_seconds"].inc(
                 plan.collective_seconds_estimate(self) * n_steps)
             # the estimate's falsifier: every sample_every-th sharded

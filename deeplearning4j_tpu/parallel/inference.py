@@ -87,8 +87,15 @@ from deeplearning4j_tpu.utils import blackbox as _blackbox
 from deeplearning4j_tpu.utils import faultpoints as _faults
 from deeplearning4j_tpu.utils import health as _health
 from deeplearning4j_tpu.utils import metrics as _metrics
+from deeplearning4j_tpu.utils import resourcemeter as _resourcemeter
 from deeplearning4j_tpu.utils import runledger as _runledger
+from deeplearning4j_tpu.utils import tenancy as _tenancy
 from deeplearning4j_tpu.utils import tracing as _tracing
+
+# canonical home moved to utils/resourcemeter (the shared tenant-keyed
+# implementation every tier books through); re-exported here because
+# this module is where serving callers historically imported it from
+from deeplearning4j_tpu.utils.resourcemeter import AdmissionBooks
 from deeplearning4j_tpu.utils.concurrency import (
     QueueAborted,
     get_abortable,
@@ -118,77 +125,6 @@ _ESTIMATOR_STALE_MIN = 1.0
 class InferenceMode:
     SEQUENTIAL = "sequential"
     BATCHED = "batched"
-
-
-class AdmissionBooks:
-    """Exact request accounting under the conservation law
-
-        admitted == completed + shed + failed
-
-    with per-"stage/reason" shed breakdowns. Admission REFUSALS land in
-    `rejected`, outside the law — the request never entered the system.
-    Keyed by tenant: ParallelInference books everything under the
-    default (None) tenant; the decode engine (serving/decode.py) keeps
-    one ledger per tenant so multi-tenant hosting's books stay exact
-    per customer. NOT internally locked — callers mutate under their
-    own admission lock, exactly as the inline counters this class
-    replaced were."""
-
-    _KEYS = ("admitted", "completed", "shed", "failed", "rejected")
-
-    def __init__(self):
-        self._tenants: dict = {}
-
-    def _t(self, tenant):
-        t = self._tenants.get(tenant)
-        if t is None:
-            t = self._tenants[tenant] = {
-                "admitted": 0, "completed": 0, "shed": 0, "failed": 0,
-                "rejected": 0, "shed_by": {}}
-        return t
-
-    def admit(self, tenant=None):
-        self._t(tenant)["admitted"] += 1
-
-    def complete(self, tenant=None):
-        self._t(tenant)["completed"] += 1
-
-    def fail(self, tenant=None):
-        self._t(tenant)["failed"] += 1
-
-    def shed(self, stage: str, reason: str, tenant=None,
-             admitted: bool = True):
-        t = self._t(tenant)
-        key = f"{stage}/{reason}"
-        t["shed_by"][key] = t["shed_by"].get(key, 0) + 1
-        t["shed" if admitted else "rejected"] += 1
-
-    def totals(self) -> dict:
-        agg = {k: 0 for k in self._KEYS}
-        agg["shed_by"] = {}
-        for t in self._tenants.values():
-            for k in self._KEYS:
-                agg[k] += t[k]
-            for sb, v in t["shed_by"].items():
-                agg["shed_by"][sb] = agg["shed_by"].get(sb, 0) + v
-        return agg
-
-    def per_tenant(self) -> dict:
-        return {
-            ("default" if t is None else t): {
-                **{k: b[k] for k in self._KEYS},
-                "shed_by": dict(b["shed_by"]),
-                "conservation_ok":
-                    b["admitted"] == b["completed"] + b["shed"] + b["failed"],
-            }
-            for t, b in self._tenants.items()
-        }
-
-    def conservation_ok(self) -> bool:
-        """The law, per tenant AND therefore in aggregate."""
-        return all(
-            t["admitted"] == t["completed"] + t["shed"] + t["failed"]
-            for t in self._tenants.values())
 
 
 class RequestValidationError(ValueError):
@@ -371,9 +307,12 @@ class ParallelInference:
         #   admitted == completed + shed + failed
         # `rejected` counts admission-control refusals — those happened
         # BEFORE admission, so they sit outside the law. The shared
-        # AdmissionBooks shape (one default tenant here; the decode
-        # engine books per tenant), mutated under self._lock.
+        # AdmissionBooks shape (utils/resourcemeter), booked per tenant
+        # (requests carry one via output(tenant=) / X-Tenant; the rest
+        # land under the default tenant), mutated under self._lock.
         self._books = AdmissionBooks()
+        _resourcemeter.register_books(_resourcemeter.TIER_SERVING,
+                                      self._books)
         # examples currently waiting in _q (admission's queue-depth
         # estimate in GROUP units: examples / max_batch_size)
         self._queued_examples = 0
@@ -472,26 +411,36 @@ class ParallelInference:
 
     # -- public --------------------------------------------------------------
 
-    def output(self, x, deadline_ms: Optional[float] = None):
+    def output(self, x, deadline_ms: Optional[float] = None,
+               tenant: Optional[str] = None):
         """Thread-safe inference. In BATCHED mode the call may be fused
         with concurrent callers' batches (reference:
         BatchedInferenceObservable). `deadline_ms` is the request's
         total latency budget from this call (falls back to
         `default_deadline_ms`; None = no deadline): a request that
         cannot make it is shed — DeadlineExceeded / RequestRejected —
-        instead of served late."""
+        instead of served late. `tenant` names who this request books
+        under (admission books + device-second spend); None falls back
+        to the thread's ambient tenant (utils/tenancy), then the
+        default tenant."""
         # run-ledger hook first (one global read when no ledger is
         # attached), then the end-to-end latency of COMPLETED requests
         # into serving_output_seconds — sheds raise out of _output_impl
         # and never observe, so the SLO objective judges served work
         _runledger.note_request()
         t0 = time.perf_counter()
-        out = self._output_impl(x, deadline_ms)
+        out = self._output_impl(x, deadline_ms, tenant)
         self._m_output_latency.observe(time.perf_counter() - t0)
         return out
 
-    def _output_impl(self, x, deadline_ms: Optional[float] = None):
+    def _output_impl(self, x, deadline_ms: Optional[float] = None,
+                     tenant: Optional[str] = None):
         xx = np.asarray(x)
+        # one canonical label for the whole request lifecycle: explicit
+        # arg wins, then the ambient thread tenant (REST handlers attach
+        # it from X-Tenant), interned through the bounded registry
+        tenant = _tenancy.intern(
+            tenant if tenant is not None else _tenancy.current_tenant())
         if deadline_ms is None:
             deadline_ms = self.default_deadline_ms
         elif not math.isfinite(float(deadline_ms)):
@@ -507,9 +456,10 @@ class ParallelInference:
         # queue item so every downstream stage (queued/dispatch/forward/
         # shed) parents here even when completed on a pipeline thread.
         # Disabled path: NULL_SPAN + None ctx after one flag check each.
-        adm_span = _tracing.span("serve/admission", rows=int(xx.shape[0]))
+        adm_span = _tracing.span("serve/admission", rows=int(xx.shape[0]),
+                                 tenant=tenant)
         with adm_span:
-            fut, ctx = self._admit(xx, deadline)
+            fut, ctx = self._admit(xx, deadline, tenant)
         if fut is not None:
             if deadline is None:
                 return fut.result()
@@ -540,35 +490,47 @@ class ParallelInference:
         # device work); finished past deadline + grace = wait-stage shed
         # (the fused waiter's backstop — a late result is never served)
         if deadline is not None and time.monotonic() >= deadline:
-            self._count_outcome("shed", stage="dispatch", reason="expired")
+            self._count_outcome("shed", stage="dispatch", reason="expired",
+                                tenant=tenant)
             self._trace_shed("dispatch", "expired", ctx)
             raise DeadlineExceeded(
                 "deadline expired before the unfused forward",
                 stage="dispatch")
+        t_fwd0 = time.perf_counter()
         try:
             with _tracing.attached_ctx(ctx):
                 out = self._run(xx)
         except BaseException:
-            self._count_outcome("failed")
+            self._count_outcome("failed", tenant=tenant)
             raise
+        # unfused forwards charge their own device window (the fused
+        # path's dispatcher charges per group); no-op when unmetered
+        _resourcemeter.note_serving_forward(
+            time.perf_counter() - t_fwd0, {tenant: int(xx.shape[0])})
         if deadline is not None \
                 and time.monotonic() >= deadline + _WAIT_SHED_GRACE:
-            self._count_outcome("shed", stage="wait", reason="expired")
+            self._count_outcome("shed", stage="wait", reason="expired",
+                                tenant=tenant)
             self._trace_shed("wait", "expired", ctx)
             raise DeadlineExceeded(
                 "deadline expired during the unfused forward",
                 stage="wait")
-        self._count_outcome("completed")
+        self._count_outcome("completed", tenant=tenant)
         return out
 
-    def _admit(self, xx: np.ndarray, deadline: Optional[float]):
+    def _admit(self, xx: np.ndarray, deadline: Optional[float],
+               tenant: str):
         """Validation + admission control + (for fusable requests) the
         enqueue, all under ONE lock hold. Returns (future, span_context):
         the future is None for requests that must run unfused on the
         caller's thread; the context is the serve/admission span's (the
         caller opens it around this call) — it rides the queue item so
         downstream lifecycle spans keep parentage across the pipeline
-        threads, and is None when tracing is off."""
+        threads, and is None when tracing is off. `tenant` (already
+        interned) books the admission; it rides the Future itself
+        (`_dl4j_tenant`) so every later outcome — resolve, fail, shed
+        from any pipeline thread — lands in the right tenant's books
+        without widening the queue/handoff tuples."""
         ctx = _tracing.current_context()
         with self._lock:
             # shutdown check and enqueue under ONE lock: a request admitted
@@ -603,7 +565,7 @@ class ParallelInference:
             # facts it reads are mutated under it) --------------------------
             now = time.monotonic()
             if deadline is not None and now >= deadline:
-                self._shed_locked("admission", "expired")
+                self._shed_locked("admission", "expired", tenant=tenant)
                 self._trace_shed("admission", "expired", ctx)
                 raise DeadlineExceeded(
                     "deadline expired before admission",
@@ -622,7 +584,7 @@ class ParallelInference:
                         if need_estimate else 0.0)
             if fusable and self.queue_capacity \
                     and self._q.qsize() >= self.queue_capacity:
-                self._shed_locked("admission", "queue_full")
+                self._shed_locked("admission", "queue_full", tenant=tenant)
                 self._trace_shed("admission", "queue_full", ctx)
                 raise RequestRejected(
                     f"request queue at capacity "
@@ -631,7 +593,8 @@ class ParallelInference:
             if fusable and deadline is not None \
                     and now + est_wait > deadline:
                 if not self._estimator_stale_locked(now, p50):
-                    self._shed_locked("admission", "predicted_late")
+                    self._shed_locked("admission", "predicted_late",
+                                      tenant=tenant)
                     self._trace_shed("admission", "predicted_late", ctx)
                     raise RequestRejected(
                         f"estimated wait {est_wait * 1e3:.0f}ms exceeds "
@@ -646,11 +609,12 @@ class ParallelInference:
                 # concurrent callers go back to shedding: one probe per
                 # staleness window, not a floodgate
                 self._m_probe.inc()
-            self._books.admit()
+            self._books.admit(tenant)
             self._m_admitted.inc()
             fut: Optional[Future] = None
             if fusable:
                 fut = Future()
+                fut._dl4j_tenant = tenant
                 self._queued_examples += xx.shape[0]
                 # put_nowait: the queue OBJECT is unbounded (the capacity
                 # bound is the admission check above), so this is exactly
@@ -704,24 +668,28 @@ class ParallelInference:
                 self._batch_lat.percentile_seconds(50))
 
     def _shed_locked(self, stage: str, reason: str,
-                     admitted: bool = False):
+                     admitted: bool = False,
+                     tenant: Optional[str] = None):
         """Book one shed under the (already-held) lock. Post-admission
         sheds land in `shed` (the conservation law's term); admission
         refusals land in `rejected` — the request never entered the
-        system. Both feed serving_shed_total{stage,reason}."""
-        self._books.shed(stage, reason, admitted=admitted)
+        system. Both feed serving_shed_total{stage,reason}, keyed by
+        the request's tenant in the books."""
+        self._books.shed(stage, reason, tenant=tenant, admitted=admitted)
         self._m_shed.labels(stage, reason).inc()
 
     def _count_outcome(self, outcome: str, stage: Optional[str] = None,
-                       reason: Optional[str] = None):
+                       reason: Optional[str] = None,
+                       tenant: Optional[str] = None):
         with self._lock:
             if outcome == "shed":
-                self._shed_locked(stage, reason, admitted=True)
+                self._shed_locked(stage, reason, admitted=True,
+                                  tenant=tenant)
                 return
             if outcome == "completed":
-                self._books.complete()
+                self._books.complete(tenant)
             else:
-                self._books.fail()
+                self._books.fail(tenant)
         (self._m_completed if outcome == "completed"
          else self._m_failed).inc()
 
@@ -733,7 +701,8 @@ class ParallelInference:
             fut.set_result(value)
         except Exception:
             return False
-        self._count_outcome("completed")
+        self._count_outcome("completed",
+                            tenant=getattr(fut, "_dl4j_tenant", None))
         return True
 
     def _fail(self, fut: Future, exc: Exception, outcome: str = "failed",
@@ -743,7 +712,8 @@ class ParallelInference:
             fut.set_exception(exc)
         except Exception:
             return False
-        self._count_outcome(outcome, stage, reason)
+        self._count_outcome(outcome, stage, reason,
+                            tenant=getattr(fut, "_dl4j_tenant", None))
         return True
 
     def _dequeued(self, item):
@@ -810,6 +780,8 @@ class ParallelInference:
                 "oversized": self._stats["oversized"],
                 "bucket_hits": dict(self._stats["bucket_hits"]),
                 **self._books.totals(),
+                "tenants": self._books.per_tenant(),
+                "conservation_ok": self._books.conservation_ok(),
             }
         m["buckets"] = list(self.buckets)
         m["max_batch_size"] = self.max_batch_size
@@ -1168,6 +1140,18 @@ class ParallelInference:
                 continue
             live_ctxs = [c for c, d in zip(ctxs, deadlines)
                          if (d is None or now < d) and c is not None]
+            # per-tenant device-second attribution for this fused group:
+            # the forward's wall time splits over the LIVE rows by
+            # tenant (shed members burned nothing). Built only when the
+            # meter is armed — the unmetered dispatcher pays one read.
+            shares = None
+            if _resourcemeter.is_enabled():
+                shares = {}
+                for fut, k, d in zip(futs, sizes, deadlines):
+                    if d is None or now < d:
+                        t = (getattr(fut, "_dl4j_tenant", None)
+                             or _tenancy.DEFAULT_TENANT)
+                        shares[t] = shares.get(t, 0) + k
             # busy only while a group is in hand: a forward that never
             # returns (device wedge) leaves this slot stale and the
             # watchdog flips serving_dispatcher to degraded/unhealthy
@@ -1187,6 +1171,9 @@ class ParallelInference:
                             t_fwd0 = time.perf_counter()
                             out = self._forward_padded(padded, n, b)
                             t_fwd1 = time.perf_counter()
+                    if shares:
+                        _resourcemeter.note_serving_forward(
+                            t_fwd1 - t_fwd0, shares)
                     off = 0
                     for fut, k in zip(futs, sizes):
                         # abort() may fail the future concurrently;
@@ -1390,7 +1377,8 @@ class ReplicaPool:
         self._m_shed.labels("resubmit", reason).inc()
         _trace_shed_span("resubmit", reason)  # caller-thread shed
 
-    def output(self, x, deadline_ms: Optional[float] = None):
+    def output(self, x, deadline_ms: Optional[float] = None,
+               tenant: Optional[str] = None):
         """Thread-safe inference with failover: retryable replica
         failures (eviction races, mid-respawn gaps) are resubmitted on a
         healthy sibling — but each request spends a bounded
@@ -1418,7 +1406,8 @@ class ReplicaPool:
                         None if req_deadline is None
                         else max(0.0, (req_deadline - time.monotonic()))
                         * 1e3)
-                    return pi.output(x, deadline_ms=remaining_ms)
+                    return pi.output(x, deadline_ms=remaining_ms,
+                                     tenant=tenant)
                 except RequestValidationError:
                     raise  # the client's fault on ANY replica
                 except (DeadlineExceeded, RequestRejected):

@@ -50,6 +50,8 @@ import numpy as np
 from deeplearning4j_tpu.utils import faultpoints as _faults
 from deeplearning4j_tpu.utils import health as _health
 from deeplearning4j_tpu.utils import metrics as _metrics
+from deeplearning4j_tpu.utils import resourcemeter as _resourcemeter
+from deeplearning4j_tpu.utils import tenancy as _tenancy
 from deeplearning4j_tpu.utils import tracing as _tracing
 from deeplearning4j_tpu.utils.concurrency import (
     QueueAborted,
@@ -324,7 +326,20 @@ class EmbeddingParameterServer:
                 # request shows up inside the caller's trace with the
                 # route named
                 with _tracing.span("ps/server/" + route):
-                    return self._post_timed(path, body)
+                    out = self._post_timed(path, body)
+                # tenant wire accounting: request + response payload,
+                # booked under the identity that arrived in X-Tenant
+                # (jsonhttp attached it to this handler thread, next to
+                # the traceparent). Charged server-side only, so an
+                # in-process client+server pair never double-counts.
+                resp = out[2] if len(out) > 2 else b""
+                _resourcemeter.note_wire(
+                    _tenancy.current_tenant(),
+                    _resourcemeter.TIER_PARAMSERVER,
+                    len(body) + (len(resp)
+                                 if isinstance(resp, (bytes, bytearray))
+                                 else 0))
+                return out
             finally:
                 self._m_rpc.labels(route).inc()
                 self._m_rpc_sec.labels(route).observe(
@@ -386,8 +401,14 @@ class EmbeddingPSClient:
     def __init__(self, urls: List[str], queue_size: int = 64,
                  timeout: float = 10.0, max_retries: int = 2,
                  retry_backoff: float = 0.05,
-                 replay_capacity: int = 128):
+                 replay_capacity: int = 128,
+                 tenant: Optional[str] = None):
         self.urls = [u.rstrip("/") for u in urls]
+        # the identity this client's RPCs book under on the server side
+        # (X-Tenant next to the traceparent). Explicit beats ambient:
+        # the push drain runs on its own thread, where the fit loop's
+        # thread-local tenant would otherwise be invisible.
+        self.tenant = None if tenant is None else _tenancy.intern(tenant)
         self.timeout = timeout
         self.max_retries = max(0, int(max_retries))
         self.retry_backoff = float(retry_backoff)
@@ -442,8 +463,10 @@ class EmbeddingPSClient:
         with _tracing.span("ps/client/" + label):
             req = urllib.request.Request(
                 f"{url}{route}", data=payload,
-                headers=traced_headers(
-                    {"Content-Type": "application/octet-stream"}))
+                headers=_tenancy.tenant_headers(
+                    traced_headers(
+                        {"Content-Type": "application/octet-stream"}),
+                    tenant=self.tenant))
             try:  # count failures too (server side does the same): an
                 # outage must show up in the RPC series, not just the
                 # drop counter

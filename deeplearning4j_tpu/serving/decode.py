@@ -84,7 +84,9 @@ from deeplearning4j_tpu.utils import blackbox as _blackbox
 from deeplearning4j_tpu.utils import faultpoints as _faults
 from deeplearning4j_tpu.utils import health as _health
 from deeplearning4j_tpu.utils import metrics as _metrics
+from deeplearning4j_tpu.utils import resourcemeter as _resourcemeter
 from deeplearning4j_tpu.utils import runledger as _runledger
+from deeplearning4j_tpu.utils import tenancy as _tenancy
 from deeplearning4j_tpu.utils import tracing as _tracing
 
 logger = logging.getLogger("deeplearning4j_tpu")
@@ -94,7 +96,8 @@ logger = logging.getLogger("deeplearning4j_tpu")
 # this only bounds wakeup latency for the notify-vs-wait race
 _IDLE_WAIT = 0.05
 
-DEFAULT_TENANT = "default"
+# the shared identity layer's default — one name across every tier
+DEFAULT_TENANT = _tenancy.DEFAULT_TENANT
 
 
 class _Request:
@@ -224,6 +227,12 @@ class DecodeEngine:
         self._slots: List[Optional[_Slot]] = [None] * self.n_slots
         self._free: List[int] = list(range(self.n_slots))
         self._books = AdmissionBooks()
+        _resourcemeter.register_books(_resourcemeter.TIER_DECODE,
+                                      self._books)
+        # HBM attribution for the live weight version (keyed per version
+        # so a drained one releases its bytes); no-op when unmetered
+        self._hbm_src: Optional[str] = None
+        self._note_weights_hbm(0, self._params)
         self._requests = 0
         self._steps = 0
         self._tokens_out = 0
@@ -284,6 +293,10 @@ class DecodeEngine:
         then the engine's): work that cannot make it is SHED
         (DeadlineExceeded / RequestRejected), never served late."""
         _runledger.note_request()
+        # canonicalize through the bounded registry: past the cap,
+        # unknown names collapse into __other__ (books and spend stay
+        # conserved; only the per-name breakdown saturates)
+        tenant = _tenancy.intern(tenant)
         try:
             p = np.asarray(prompt, np.int64)
         except (TypeError, ValueError) as e:
@@ -445,6 +458,23 @@ class DecodeEngine:
 
     def _swaps_pending_locked(self) -> int:
         return 1 if self._pending_swap is not None else 0
+
+    def _note_weights_hbm(self, version: int, params) -> None:
+        """Attribute the live weight version's device bytes in the HBM
+        gauge (weights serve every tenant, so they book under the shared
+        default tenant), keyed per version: committing v releases v-1's
+        bytes. Accounted at the flip — the commit-beside window where
+        two versions coexist is transient and never metered. One
+        module-global read when unmetered."""
+        if not _resourcemeter.is_enabled():
+            return
+        src = f"decode_weights_{id(self)}_v{version}"
+        nbytes = sum(int(getattr(a, "nbytes", 0) or 0)
+                     for a in jax.tree_util.tree_leaves(params))
+        _resourcemeter.note_hbm(DEFAULT_TENANT, src, nbytes)
+        old, self._hbm_src = self._hbm_src, src
+        if old is not None:
+            _resourcemeter.note_hbm(DEFAULT_TENANT, old, 0)
 
     @property
     def version(self) -> int:
@@ -682,6 +712,7 @@ class DecodeEngine:
                 self._version = v
                 self._swaps += 1
             self._m_swaps.inc()
+            self._note_weights_hbm(v, placed)
             _tracing.record_complete("decode/swap", t0,
                                      time.perf_counter(), None, version=v)
             _blackbox.get_recorder().record_event(
@@ -738,6 +769,16 @@ class DecodeEngine:
                 return True
         dt = time.perf_counter() - t0
         self._m_steps.observe(dt)
+        if _resourcemeter.is_enabled():
+            # split this step's wall time over the tenants whose slots
+            # it advanced: weighted-fair scheduling becomes auditable
+            # device-second SPEND. Shares built only when metered — the
+            # unmetered loop pays one module-global read per step.
+            shares: Dict[str, int] = {}
+            for _, s in active:
+                t = s.req.tenant
+                shares[t] = shares.get(t, 0) + 1
+            _resourcemeter.note_decode_step(dt, shares)
         with self._lock:
             self._steps += 1
         # 4. host bookkeeping per active slot
@@ -811,9 +852,11 @@ class DecodeEngine:
             tr = req.ctx.trace_id if req.ctx is not None else None
             gap = (t_emit - req.last_emit) / len(emitted)
             for _ in emitted:
-                self._m_token_lat.observe(gap, trace_id=tr)
+                self._m_token_lat.observe(gap, trace_id=tr,
+                                          tenant=req.tenant)
             req.last_emit = t_emit
             self._m_tokens.labels(req.tenant).inc(len(emitted))
+            _resourcemeter.note_tokens(req.tenant, len(emitted))
             with self._lock:
                 self._tokens_out += len(emitted)
         if done:
@@ -852,9 +895,11 @@ class DecodeEngine:
         req.tokens.append(token)
         self._feed[idx] = token
         tr = req.ctx.trace_id if req.ctx is not None else None
-        self._m_token_lat.observe(t_emit - req.last_emit, trace_id=tr)
+        self._m_token_lat.observe(t_emit - req.last_emit, trace_id=tr,
+                                  tenant=req.tenant)
         req.last_emit = t_emit
         self._m_tokens.labels(req.tenant).inc()
+        _resourcemeter.note_tokens(req.tenant, 1)
         with self._lock:
             self._tokens_out += 1
         if req.on_token is not None:

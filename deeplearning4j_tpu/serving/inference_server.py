@@ -40,6 +40,14 @@ device execution). Routes:
                       pending/firing lifecycle, recent transitions —
                       machine-readable verdicts, not just gauges
                       (start with --ledger or run_ledger=)
+    GET  /tenants  -> the chip-budget view (utils/resourcemeter):
+                      per-tenant spend (device-seconds by tier, wire
+                      bytes, tokens, HBM), merged admission books,
+                      conservation verdicts, firing per-tenant SLO
+                      rules. Requests name their tenant via a JSON
+                      "tenant" field or the X-Tenant header (field
+                      wins, case-insensitive — the deadline contract's
+                      shape); spend metering arms with --meter.
 
 Knobs (constructor and CLI flags): `max_batch_size`, `batch_timeout_ms`,
 `buckets`, `warmup_shape` (precompiles every bucket before the port
@@ -69,7 +77,9 @@ from deeplearning4j_tpu.parallel.inference import (
 from deeplearning4j_tpu.serving.decode import DecodeEngine
 from deeplearning4j_tpu.utils import health as _health
 from deeplearning4j_tpu.utils import metrics as _metrics
+from deeplearning4j_tpu.utils import resourcemeter as _resourcemeter
 from deeplearning4j_tpu.utils import runledger as _runledger
+from deeplearning4j_tpu.utils import tenancy as _tenancy
 from deeplearning4j_tpu.utils import tracing as _tracing
 from deeplearning4j_tpu.utils.jsonhttp import JsonHttpServer, json_response
 from deeplearning4j_tpu.utils.latency import LatencyTracker
@@ -190,7 +200,8 @@ class InferenceServer:
             {"le_ms": (e["le"] if isinstance(e["le"], str)
                        else round(e["le"] * 1e3, 6)),
              "value_ms": round(e["value"] * 1e3, 6),
-             "trace_id": e["trace_id"], "ts": e["ts"]}
+             "trace_id": e["trace_id"], "ts": e["ts"],
+             **({"tenant": e["tenant"]} if "tenant" in e else {})}
             for e in self._m_latency.exemplars()]
         if self.decode is not None:
             # the autoregressive tier's books on the same scrape: slot
@@ -260,6 +271,23 @@ class InferenceServer:
             except ValueError:
                 n = None
             return 200, "application/x-ndjson", tracer.to_jsonl(n).encode()
+        if route == "/tenants":
+            # the chip-budget view: per-tenant spend (device-seconds by
+            # tier, wire bytes, tokens/examples, HBM) + merged outcome
+            # books + the conservation verdicts, plus which per-tenant
+            # SLO rules fire right now (from this server's ledger)
+            doc = _resourcemeter.snapshot()
+            led = (self._owned_ledger or self._attached_ledger
+                   or _runledger.current())
+            if led is not None:
+                try:
+                    st = led.alert_status()
+                    doc["slo_firing"] = [
+                        r for r in st.get("firing", [])
+                        if "tenant" in str(r)]
+                except Exception:
+                    pass
+            return json_response(doc)
         return None
 
     @staticmethod
@@ -288,6 +316,18 @@ class InferenceServer:
                 {"error": f"deadline_ms must be finite, "
                           f"got {deadline_ms!r}"}, 400)
         return deadline_ms, None
+
+    @staticmethod
+    def _extract_tenant(req: dict, headers: dict):
+        """The ONE tenant contract, mirroring _parse_deadline: the JSON
+        `tenant` field wins over the X-Tenant header (case-insensitive),
+        falling back to the ambient tenant jsonhttp attached from the
+        same header — so the result is always a concrete interned name
+        (DEFAULT_TENANT when nobody said anything)."""
+        tenant = req.get("tenant")
+        if tenant is None:
+            tenant = _tenancy.from_headers(headers)
+        return _tenancy.intern(tenant)
 
     @staticmethod
     def _shed_response(e):
@@ -327,10 +367,13 @@ class InferenceServer:
             # the request's serving span: nests under jsonhttp's
             # http/server span (which joined the caller's traceparent,
             # or rooted a fresh trace) on this handler thread
+            tenant = self._extract_tenant(req, headers)
             sp = _tracing.span("serve/predict",
-                               examples=int(feats.shape[0]))
+                               examples=int(feats.shape[0]),
+                               tenant=tenant)
             with sp:
-                out = self.inference.output(feats, deadline_ms=deadline_ms)
+                out = self.inference.output(feats, deadline_ms=deadline_ms,
+                                            tenant=tenant)
         except RequestValidationError as e:  # the client's fault
             return json_response({"error": str(e)}, 400)
         except (RequestRejected, DeadlineExceeded) as e:
@@ -384,7 +427,7 @@ class InferenceServer:
         deadline_ms, err = self._parse_deadline(req, headers)
         if err is not None:
             return err
-        tenant = str(req.get("tenant", "default"))
+        tenant = self._extract_tenant(req, headers)
         max_tokens = req.get("max_tokens")
         if max_tokens is not None:
             try:
@@ -525,7 +568,14 @@ def main(argv=None):
                     help="EOS token id ending a generated sequence early")
     ap.add_argument("--decodeMaxTokens", type=int, default=64,
                     help="default max_tokens for /generate requests")
+    ap.add_argument("--meter", action="store_true",
+                    help="arm per-tenant resource metering "
+                         "(utils/resourcemeter): GET /tenants then "
+                         "reports device-seconds/wire/HBM spend, not "
+                         "just admission books")
     args = ap.parse_args(argv)
+    if args.meter:
+        _resourcemeter.enable()
     from deeplearning4j_tpu.cli import guess_and_load_model
 
     model = guess_and_load_model(args.modelPath)

@@ -226,6 +226,14 @@ class FlightRecorder:
             health = get_health().status()
         except Exception:
             health = None
+        try:
+            # the chip-budget view at crash time: who was spending what
+            # when the process died (books always; spend when metered)
+            from deeplearning4j_tpu.utils import resourcemeter
+
+            tenants = resourcemeter.snapshot()
+        except Exception:
+            tenants = None
         return {
             "reason": reason,
             "ts": round(time.time(), 3),
@@ -237,6 +245,7 @@ class FlightRecorder:
             "events": events,
             "metrics_deltas": deltas,
             "health": health,
+            "tenants": tenants,
             "threads": thread_stacks(),
         }
 
@@ -496,6 +505,28 @@ def render_dump(doc: dict, max_steps: int = 32,
                 note = (f"  stalled {d.get('stalled_for_seconds')}s"
                         f" threads={d.get('stalled_threads')}")
             lines.append(f"  {name}: {d.get('status')}{note}")
+    tenants_doc = doc.get("tenants") or {}
+    tenant_rows = tenants_doc.get("tenants") or {}
+    if tenant_rows:
+        cons = tenants_doc.get("conservation") or {}
+        lines.append("")
+        lines.append(f"tenant chip budget (books_ok={cons.get('books_ok')} "
+                     f"spend_ok={cons.get('spend_ok')}):")
+        for t in sorted(tenant_rows):
+            rec = tenant_rows[t] or {}
+            dev = rec.get("device_seconds") or {}
+            parts = []
+            if dev:
+                parts.append("dev[s] " + " ".join(
+                    f"{tier}={s:.4g}" for tier, s in sorted(dev.items())))
+            b = rec.get("books")
+            if b:
+                parts.append(f"adm={b.get('admitted', 0)} "
+                             f"done={b.get('completed', 0)} "
+                             f"shed={b.get('shed', 0)} "
+                             f"fail={b.get('failed', 0)}")
+            lines.append(f"  {t}: " + ("  ".join(parts) if parts
+                                       else "(idle)"))
     threads = doc.get("threads") or []
     if threads:
         lines.append("")

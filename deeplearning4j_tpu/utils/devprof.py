@@ -46,6 +46,7 @@ from typing import List, Optional
 
 from deeplearning4j_tpu.utils import blackbox as _blackbox
 from deeplearning4j_tpu.utils import metrics as _metrics
+from deeplearning4j_tpu.utils import resourcemeter as _resourcemeter
 
 logger = logging.getLogger("deeplearning4j_tpu")
 
@@ -187,6 +188,8 @@ class DeviceProfiler:
         now = time.perf_counter()
         last = st["last_t"]
         iteration = int(getattr(net, "iteration", 0))
+        dt = 0.0
+        window_examples = st["examples"]
         if last is not None and now > last and st["examples"] > 0:
             dt = now - last
             per_example, source = net.model_flops_per_example()
@@ -215,6 +218,13 @@ class DeviceProfiler:
         st["iter_at_last"] = iteration
         st["examples"] = 0
         self.poll_memory(net, st)
+        if dt > 0:
+            # tenant chip-budget attribution rides the SAME measured
+            # window (no extra sync): after poll_memory so the cached
+            # params/updater byte sums exist for the HBM gauge. One
+            # module-global read when the process is unmetered.
+            _resourcemeter.note_device_window(net, dt,
+                                              examples=window_examples)
 
     # -- memory watermarks ---------------------------------------------------
 

@@ -21,6 +21,11 @@ half-empty context. On the client side, `traced_headers()` merges the
 active context into an outbound header dict. Both hooks degrade to one
 flag check when tracing is off (the serving hot-path overhead guard
 covers them).
+
+Tenant identity (utils/tenancy) rides the same rails: `_dispatch`
+attaches the `X-Tenant` header's value for the handler's duration and
+`traced_headers()` injects the ambient tenant outbound, so a request's
+tenant crosses process boundaries next to its traceparent.
 """
 
 from __future__ import annotations
@@ -34,6 +39,7 @@ from typing import Callable, Optional, Tuple
 
 from deeplearning4j_tpu.utils import faultpoints as _faults
 from deeplearning4j_tpu.utils import metrics as _metrics
+from deeplearning4j_tpu.utils import tenancy as _tenancy
 from deeplearning4j_tpu.utils import tracing as _tracing
 
 # handler contract: fn(path, body_bytes, headers) ->
@@ -88,6 +94,12 @@ def traced_headers(headers: Optional[dict] = None) -> dict:
     tp = _tracing.current_traceparent()
     if tp is not None:
         out["traceparent"] = tp
+    # the tenant identity rides next to the traceparent (one
+    # thread-local read when no tenant is attached): a paramserver pull
+    # from a metered fit carries the same identity serving books under
+    t = _tenancy.current_tenant()
+    if t is not None:
+        out[_tenancy.HEADER] = t
     return out
 
 
@@ -159,6 +171,15 @@ class JsonHttpServer:
                                          path=self.path)
                 else:
                     span = _tracing.NULL_SPAN
+                # tenant identity rides NEXT TO the traceparent: attach
+                # the X-Tenant header (if any) for the handler's
+                # duration, so books/spend/exemplars on this thread
+                # carry the caller's identity. Always-on — attach(None)
+                # is one thread-local store; handlers that extract a
+                # JSON-field tenant themselves still win (explicit args
+                # override the ambient value downstream).
+                ten_tok = _tenancy.attach(
+                    _tenancy.from_headers(self.headers))
                 try:
                     with span:
                         try:
@@ -227,6 +248,7 @@ class JsonHttpServer:
                             # nothing here
                             self.close_connection = True
                 finally:
+                    _tenancy.detach(ten_tok)
                     if traced:
                         _tracing.detach(tok)
 

@@ -59,6 +59,7 @@ import time
 from collections import deque
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
+from deeplearning4j_tpu.utils import tenancy as _tenancy
 from deeplearning4j_tpu.utils import tracing as _tracing
 from deeplearning4j_tpu.utils.latency import percentile
 
@@ -177,15 +178,22 @@ class HistogramChild(_Child):
         self._count = 0
         self._sum = 0.0
         self._window = deque(maxlen=window)
-        # bucket index -> (value, trace_id, ts): the bucket's max-value
-        # exemplar — bounded at len(bounds)+1 entries by construction
-        self._exemplars: Dict[int, Tuple[float, str, float]] = {}
+        # bucket index -> (value, trace_id, ts, tenant): the bucket's
+        # max-value exemplar — bounded at len(bounds)+1 entries by
+        # construction. `tenant` is the thread-ambient identity
+        # (utils/tenancy) at observe time, None when nobody attached one.
+        self._exemplars: Dict[int, Tuple[float, str, float,
+                                         Optional[str]]] = {}
 
-    def observe(self, value: float, trace_id: Optional[str] = None):
+    def observe(self, value: float, trace_id: Optional[str] = None,
+                tenant: Optional[str] = None):
         """Record one observation. `trace_id` links it to a trace for
         exemplar capture; when omitted, the active trace (utils/tracing)
         is used — one flag check when tracing is off, so the hot paths
-        that observe with tracing disabled pay nothing."""
+        that observe with tracing disabled pay nothing. `tenant`
+        overrides the thread-ambient identity for exemplar tagging —
+        engine loops observing on a shared worker thread (no ambient
+        tenant) pass the request's own."""
         v = float(value)
         i = bisect.bisect_left(self._bounds, v)
         if trace_id is None and _tracing.is_enabled():
@@ -200,7 +208,9 @@ class HistogramChild(_Child):
                 ex = self._exemplars.get(i)
                 if ex is None or v > ex[0] \
                         or now - ex[2] > _EXEMPLAR_MAX_AGE:
-                    self._exemplars[i] = (v, trace_id, now)
+                    if tenant is None:
+                        tenant = _tenancy.current_tenant()
+                    self._exemplars[i] = (v, trace_id, now, tenant)
 
     @property
     def count(self) -> int:
@@ -238,10 +248,13 @@ class HistogramChild(_Child):
             items = sorted(self._exemplars.items())
         bounds = self._bounds
         out = []
-        for i, (v, trace_id, ts) in items:
+        for i, (v, trace_id, ts, tenant) in items:
             le = bounds[i] if i < len(bounds) else float("inf")
-            out.append({"le": "+Inf" if math.isinf(le) else le,
-                        "value": v, "trace_id": trace_id, "ts": ts})
+            ex = {"le": "+Inf" if math.isinf(le) else le,
+                  "value": v, "trace_id": trace_id, "ts": ts}
+            if tenant is not None:
+                ex["tenant"] = tenant
+            out.append(ex)
         return out
 
 
@@ -297,8 +310,9 @@ class MetricFamily:
     def set_function(self, fn: Callable[[], float]):
         self.labels().set_function(fn)
 
-    def observe(self, value: float, trace_id: Optional[str] = None):
-        self.labels().observe(value, trace_id)
+    def observe(self, value: float, trace_id: Optional[str] = None,
+                tenant: Optional[str] = None):
+        self.labels().observe(value, trace_id, tenant)
 
     @property
     def value(self):

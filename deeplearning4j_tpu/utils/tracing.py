@@ -49,6 +49,8 @@ import time
 from collections import deque
 from typing import List, Optional
 
+from deeplearning4j_tpu.utils import tenancy as _tenancy
+
 # span ids are ints, unique within a process and unlikely to collide
 # across processes: the counter starts at a random 60-bit offset so two
 # processes exporting into one trace don't both hand out 1, 2, 3...
@@ -236,9 +238,16 @@ class Tracer:
     # -- recording -----------------------------------------------------------
 
     def span(self, name: str, **args):
-        """Context manager timing a section. Disabled -> shared no-op."""
+        """Context manager timing a section. Disabled -> shared no-op.
+        With a thread-ambient tenant attached (utils/tenancy — REST
+        handlers attach it from X-Tenant), spans carry it as a `tenant`
+        attribute; an explicit tenant= arg wins."""
         if not self.enabled:
             return NULL_SPAN
+        if "tenant" not in args:
+            t = _tenancy.current_tenant()
+            if t is not None:
+                args["tenant"] = t
         return _Span(self, name, args or None)
 
     def instant(self, name: str, **args):

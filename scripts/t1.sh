@@ -66,6 +66,23 @@ else
     exit 1
 fi
 
+# -- tenant-books smoke --------------------------------------------------------
+# The cross-tier chip-budget ledger (utils/resourcemeter + utils/tenancy):
+# two tenants through the decode smoke plus one metered fit in its own
+# interpreter, asserting per-tenant device-seconds sum to the process
+# total per tier (spend conservation), the outcome books balance, and
+# `cli tenants` renders the in-process view with exit 0.
+rm -f /tmp/_t1_tenants.log
+if timeout -k 10 240 env JAX_PLATFORMS=cpu \
+    python -m deeplearning4j_tpu.utils.resourcemeter --smoke \
+    > /tmp/_t1_tenants.log 2>&1; then
+    echo "T1 TENANT BOOKS: ok (decode tenants + metered fit, cross-tier conservation)"
+else
+    echo "T1 TENANT BOOKS: FAILED — tail of /tmp/_t1_tenants.log:"
+    tail -20 /tmp/_t1_tenants.log
+    exit 1
+fi
+
 # -- kernel-coverage smoke ----------------------------------------------------
 # The 53/53 contract (analysis/kernelcoverage.py): every ResNet-50 conv
 # instance must resolve to covered or declined-with-roofline-verdict in
