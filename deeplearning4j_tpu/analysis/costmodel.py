@@ -357,6 +357,18 @@ class CostModel:
     updater_bytes: int = 0
     data_bytes: int = 0
     const_bytes: int = 0
+    # bytes (within param_bytes/updater_bytes) belonging to layers
+    # declared `host_resident=True` (host-sharded embedding tables pulled
+    # row-wise through the paramserver) — they never occupy device HBM,
+    # so resident_bytes exempts them
+    host_resident_param_bytes: int = 0
+    host_resident_updater_bytes: int = 0
+    # the traced on-device step also holds the table AND its cotangent
+    # (the dense scatter-add gradient) live in the activation peak; the
+    # pipeline keeps both host-side (rows pulled, row deltas pushed), so
+    # that table-shaped share of the peak is exempt too (clamped to the
+    # measured peak — an estimator, never negative)
+    host_resident_activation_bytes: int = 0
     batch: Optional[int] = None
     # data-axis shard count of the net this step was traced from (1 for
     # single-device nets): the traced program is the GLOBAL step, so
@@ -406,10 +418,16 @@ class CostModel:
         consts replicated (full size per chip), data and activations
         batch-sharded (divided by the data-axis size). Params held twice
         when not donated is deliberately NOT modeled — JX006 audits
-        donation separately."""
+        donation separately. Host-resident tables (sparse embedding
+        weights served row-wise by the paramserver) are subtracted —
+        they live in host RAM, not HBM."""
         n = max(1, self.data_axis_shards)
-        return (self.param_bytes + self.updater_bytes + self.const_bytes
-                + (self.data_bytes + self.activation_peak_bytes) // n)
+        device_param = self.param_bytes - self.host_resident_param_bytes
+        device_upd = self.updater_bytes - self.host_resident_updater_bytes
+        device_act = max(
+            0, self.activation_peak_bytes - self.host_resident_activation_bytes)
+        return (device_param + device_upd + self.const_bytes
+                + (self.data_bytes + device_act) // n)
 
     def roofline(self, peak_flops: Optional[float] = None,
                  hbm_bandwidth: Optional[float] = None) -> dict:
@@ -484,6 +502,10 @@ class CostModel:
             "largest_activation": self.largest_activation,
             "param_bytes": self.param_bytes,
             "updater_bytes": self.updater_bytes,
+            "host_resident_param_bytes": self.host_resident_param_bytes,
+            "host_resident_updater_bytes": self.host_resident_updater_bytes,
+            "host_resident_activation_bytes":
+                self.host_resident_activation_bytes,
             "data_bytes": self.data_bytes,
             "const_bytes": self.const_bytes,
             "data_axis_shards": self.data_axis_shards,
@@ -582,6 +604,29 @@ def train_step_args(net, *, batch_size: int = 8, timesteps: int = 16):
     return step, args
 
 
+def _host_resident_bytes(net) -> Tuple[int, int]:
+    """(param, updater) bytes of layers tagged `host_resident=True` —
+    host-sharded embedding tables served by the paramserver. Walks
+    `_ordered_layer_confs()` (aligned with params_list / upd_state on
+    both MLN and graph); a net without that surface is simply all
+    device-resident."""
+    try:
+        confs = net._ordered_layer_confs()
+        params = net.params_list
+        upd = getattr(net, "upd_state", None) or [None] * len(params)
+    except Exception:
+        return 0, 0
+    hp = hu = 0
+    for i, conf in enumerate(confs):
+        if not getattr(conf, "host_resident", False):
+            continue
+        if i < len(params):
+            hp += _tree_bytes(params[i])
+        if i < len(upd):
+            hu += _tree_bytes(upd[i])
+    return hp, hu
+
+
 def _model_of_step(net, step, args, batch_size: int) -> CostModel:
     """Trace + static memory bookkeeping shared by train_step_cost and
     check_network (args[3:5] are the feature/label structs (MLN) or
@@ -591,6 +636,13 @@ def _model_of_step(net, step, args, batch_size: int) -> CostModel:
     cm.param_bytes = _tree_bytes(net.params_list)
     cm.updater_bytes = _tree_bytes(net.upd_state)
     cm.data_bytes = _tree_bytes((args[3], args[4]))
+    hp, hu = _host_resident_bytes(net)
+    cm.host_resident_param_bytes = hp
+    cm.host_resident_updater_bytes = hu
+    # table + its cotangent ride the activation peak in the traced
+    # device program; host-side they are paramserver traffic, not HBM
+    cm.host_resident_activation_bytes = min(
+        int(cm.activation_peak_bytes), 2 * hp)
     plan = getattr(net, "_mesh_plan", None)
     if plan is not None:
         cm.data_axis_shards = max(1, int(plan.n_data_shards))
@@ -676,16 +728,20 @@ def residency_findings(cm: CostModel,
     resident = cm.resident_bytes
     if resident <= hbm_bytes:
         return []
+    exempt = cm.host_resident_param_bytes + cm.host_resident_updater_bytes
+    exempt_note = (f"; {exempt / 2**30:.2f} GiB of host-resident tables "
+                   "already exempted" if exempt else "")
     return [Finding(
         "JX008", ERROR, f"costmodel:{cm.what}",
         f"static peak memory estimate {resident / 2**30:.2f} GiB exceeds "
         f"device HBM {hbm_bytes / 2**30:.2f} GiB (activations "
         f"{cm.activation_peak_bytes / 2**30:.2f} GiB, params "
         f"{cm.param_bytes / 2**30:.2f} GiB, updater "
-        f"{cm.updater_bytes / 2**30:.2f} GiB) — the step will OOM "
-        "before it runs",
-        "shrink the batch, enable rematerialization, or shard the model "
-        "(parallel/ tensor/pipeline parallelism)",
+        f"{cm.updater_bytes / 2**30:.2f} GiB{exempt_note}) — the step "
+        "will OOM before it runs",
+        "shrink the batch, enable rematerialization, shard the model "
+        "(parallel/ tensor/pipeline parallelism), or mark embedding "
+        "tables host_resident and serve them via the paramserver",
         name=f"JX008:costmodel:{cm.what}")]
 
 

@@ -284,12 +284,20 @@ def _labels_sds(out_type, batch: int, timesteps: int):
     return None
 
 
-def _param_leaf_labels(params_list, layer_names) -> List[str]:
-    """One label per flattened param leaf: '<layer>/<param name>'."""
+def _param_leaf_labels(params_list, layer_names,
+                       skip_idx=()) -> List[Optional[str]]:
+    """One label per flattened param leaf: '<layer>/<param name>'.
+    Leaves of layers in `skip_idx` get label None — the dead-arg check
+    skips unlabeled invars, which is how host-resident embedding tables
+    (trained through the paramserver, not the device cotangent path)
+    are exempted from JX005."""
     labels = []
     leaves_with_path, _ = jax.tree_util.tree_flatten_with_path(params_list)
     for path, _ in leaves_with_path:
         idx = next((p.idx for p in path if hasattr(p, "idx")), None)
+        if idx is not None and idx in skip_idx:
+            labels.append(None)
+            continue
         key = next((p.key for p in path if hasattr(p, "key")), "?")
         layer = layer_names[idx] if idx is not None and \
             idx < len(layer_names) else f"layer[{idx}]"
@@ -357,7 +365,14 @@ def audit_network(net, *, batch_size: int = 2, timesteps: int = 8,
     # dead-weight analysis: which param leaves reach the score output
     # (`loss` returns ONLY the scalar score, so every program output is
     # score — liveness against all outputs IS the cotangent-path check)
-    param_labels = _param_leaf_labels(net.params_list, layer_names)
+    try:
+        host_idx = frozenset(
+            i for i, lc in enumerate(net._ordered_layer_confs())
+            if getattr(lc, "host_resident", False))
+    except Exception:
+        host_idx = frozenset()
+    param_labels = _param_leaf_labels(net.params_list, layer_names,
+                                      skip_idx=host_idx)
     all_labels = param_labels + [None] * (
         len(closed.jaxpr.invars) - len(param_labels))
     findings.extend(_dead_arg_findings(

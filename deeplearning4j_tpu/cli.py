@@ -1140,8 +1140,12 @@ def _preset_network(args):
         from deeplearning4j_tpu.models.charlstm import char_lstm_network
 
         return char_lstm_network()
+    if preset == "recsys":
+        from deeplearning4j_tpu.models.recsys import recsys_network
+
+        return recsys_network(host_resident=True)
     raise SystemExit(f"unknown --preset {preset!r} "
-                     "(resnet50|tiny_resnet|charlstm)")
+                     "(resnet50|tiny_resnet|charlstm|recsys)")
 
 
 def _chaos_net(n_in: int = 8):

@@ -1,0 +1,53 @@
+"""Recsys preset: an embedding table + dense tower classifier.
+
+The model half of the `bench.py recsys` workload and the JX008
+host-residency regression tests: one (optionally huge) EmbeddingLayer
+whose table can be declared `host_resident=True` — row-sharded across
+paramserver endpoints and trained through the sparse pipeline
+(parallel/sparse) — followed by a small dense tower that runs as a
+normal jitted device step. With `host_resident=False` the same conf is
+the control: the residency audit must then count the table against HBM
+and fail when it does not fit.
+"""
+
+from __future__ import annotations
+
+from deeplearning4j_tpu.nn.conf import (
+    DenseLayer,
+    EmbeddingLayer,
+    InputType,
+    NeuralNetConfiguration,
+    OutputLayer,
+    Updater,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+def recsys_conf(vocab: int = 100_000, dim: int = 64, hidden: int = 128,
+                classes: int = 2, host_resident: bool = True,
+                seed: int = 7, learning_rate: float = 0.05):
+    return (
+        NeuralNetConfiguration.builder()
+        .seed(seed)
+        .updater(Updater.SGD)
+        .learning_rate(learning_rate)
+        .weight_init("xavier")
+        .list()
+        .layer(EmbeddingLayer(n_in=vocab, n_out=dim, has_bias=False,
+                              activation="identity",
+                              host_resident=host_resident))
+        .layer(DenseLayer(n_out=hidden, activation="relu"))
+        .layer(DenseLayer(n_out=hidden, activation="relu"))
+        .layer(OutputLayer(n_out=classes, activation="softmax",
+                           loss="mcxent"))
+        .set_input_type(InputType.feed_forward(1))
+        .build()
+    )
+
+
+def recsys_network(vocab: int = 100_000, dim: int = 64, hidden: int = 128,
+                   classes: int = 2, host_resident: bool = True,
+                   **kw) -> MultiLayerNetwork:
+    return MultiLayerNetwork(
+        recsys_conf(vocab, dim, hidden, classes, host_resident, **kw)
+    ).init()

@@ -169,9 +169,16 @@ class EmbeddingLayer(FeedForwardLayerConf):
     """Index lookup layer (reference: EmbeddingLayer.java). Input: integer
     indices [batch] or [batch, 1]. On TPU the lookup compiles to a gather;
     a one-hot-matmul path is used under jit where gather scatter-grads are
-    slow (see ops/embedding_ops)."""
+    slow (see ops/embedding_ops).
+
+    `host_resident=True` declares the table lives on the HOST (sharded
+    across paramserver endpoints, rows pulled/pushed through
+    parallel/sparse.SparseEmbeddingPipeline) rather than in device HBM —
+    the residency audit (JX008) and dead-weight liveness (JX005) then
+    exempt its weights from the per-chip memory picture."""
 
     has_bias: bool = True
+    host_resident: bool = False
 
 
 @register_config("layer.convolution")

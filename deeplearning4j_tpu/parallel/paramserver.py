@@ -76,46 +76,99 @@ logger = logging.getLogger("deeplearning4j_tpu")
 #               | i64 * n_rows row ids | f32 * n_rows * dim deltas
 #               (dim == 0 for pulls: no payload follows the ids)
 #   pull rsp := u32 n_rows | u32 dim | f32 * n_rows * dim raw rows
+#
+# bf16 wire payload (opt-in, EmbeddingPSClient(wire_dtype="bf16")): the
+# top bit of the `dim` field tags the ROW PAYLOAD as bf16 (u16 per
+# element, round-to-nearest-even truncation of the f32). A pull request
+# (dim == 0, no payload) sets the tag to ask for a bf16 RESPONSE.
+# Accumulation stays f32 on both ends — only the wire narrows, halving
+# `paramserver_wire_bytes_total` for the row blocks. Row ids stay i64.
+# An untagged request is f32, so old clients keep working unchanged.
+
+_BF16_FLAG = 0x80000000
+
+
+def _bf16_from_f32(a: np.ndarray) -> np.ndarray:
+    """f32 -> bf16 (as u16), round-to-nearest-even. NaN payloads can
+    carry into the exponent under RNE — pinned to the canonical quiet
+    NaN instead (sign preserved)."""
+    u = np.ascontiguousarray(a, "<f4").view("<u4")
+    rne = ((u >> np.uint32(16)) & np.uint32(1)) + np.uint32(0x7FFF)
+    out = ((u + rne) >> np.uint32(16)).astype("<u2")
+    nan = ((u & np.uint32(0x7F800000)) == np.uint32(0x7F800000)) \
+        & ((u & np.uint32(0x007FFFFF)) != 0)
+    if nan.any():
+        out = np.where(
+            nan, ((u >> np.uint32(16)) & np.uint16(0x8000))
+            .astype("<u2") | np.uint16(0x7FC0), out)
+    return out
+
+
+def _f32_from_bf16(u16: np.ndarray) -> np.ndarray:
+    return (np.ascontiguousarray(u16, "<u2").astype("<u4")
+            << np.uint32(16)).view("<f4")
+
 
 def _pack_request(table: str, rows: np.ndarray,
-                  deltas: Optional[np.ndarray] = None) -> bytes:
+                  deltas: Optional[np.ndarray] = None,
+                  wire_dtype: str = "f32") -> bytes:
     name = table.encode()
     rows = np.ascontiguousarray(rows, dtype="<i8")
+    flag = _BF16_FLAG if wire_dtype == "bf16" else 0
     if deltas is None:
         head = struct.pack("<H", len(name)) + name + struct.pack(
-            "<II", rows.size, 0)
+            "<II", rows.size, flag)
         return head + rows.tobytes()
-    deltas = np.ascontiguousarray(deltas, dtype="<f4")
+    deltas = np.asarray(deltas, np.float32)
     if deltas.ndim != 2 or deltas.shape[0] != rows.size:
         raise ValueError(f"deltas must be [n_rows, dim], got {deltas.shape} "
                          f"for {rows.size} rows")
     head = struct.pack("<H", len(name)) + name + struct.pack(
-        "<II", rows.size, deltas.shape[1])
-    return head + rows.tobytes() + deltas.tobytes()
+        "<II", rows.size, deltas.shape[1] | flag)
+    payload = (_bf16_from_f32(deltas) if flag
+               else np.ascontiguousarray(deltas, "<f4"))
+    return head + rows.tobytes() + payload.tobytes()
 
 
 def _unpack_request(body: bytes):
+    """Returns (table, rows, deltas_f32_or_None, wire_dtype) — the dtype
+    tag tells a pull handler which payload encoding the CLIENT asked the
+    response to use."""
     (name_len,) = struct.unpack_from("<H", body, 0)
     name = body[2:2 + name_len].decode()
     n, dim = struct.unpack_from("<II", body, 2 + name_len)
+    wire_dtype = "bf16" if dim & _BF16_FLAG else "f32"
+    dim &= ~_BF16_FLAG
     off = 2 + name_len + 8
     rows = np.frombuffer(body, "<i8", count=n, offset=off)
     off += 8 * n
     deltas = None
     if dim:
-        deltas = np.frombuffer(body, "<f4", count=n * dim,
-                               offset=off).reshape(n, dim)
-    return name, rows, deltas
+        if wire_dtype == "bf16":
+            deltas = _f32_from_bf16(np.frombuffer(
+                body, "<u2", count=n * dim, offset=off)).reshape(n, dim)
+        else:
+            deltas = np.frombuffer(body, "<f4", count=n * dim,
+                                   offset=off).reshape(n, dim)
+    return name, rows, deltas, wire_dtype
 
 
-def _pack_rows(rows: np.ndarray) -> bytes:
-    rows = np.ascontiguousarray(rows, dtype="<f4")
+def _pack_rows(rows: np.ndarray, wire_dtype: str = "f32") -> bytes:
+    rows = np.asarray(rows, np.float32)
     n, dim = rows.shape
-    return struct.pack("<II", n, dim) + rows.tobytes()
+    if wire_dtype == "bf16":
+        return struct.pack("<II", n, dim | _BF16_FLAG) \
+            + _bf16_from_f32(rows).tobytes()
+    return struct.pack("<II", n, dim) \
+        + np.ascontiguousarray(rows, "<f4").tobytes()
 
 
 def _unpack_rows(body: bytes) -> np.ndarray:
     n, dim = struct.unpack_from("<II", body, 0)
+    if dim & _BF16_FLAG:
+        dim &= ~_BF16_FLAG
+        return _f32_from_bf16(np.frombuffer(
+            body, "<u2", count=n * dim, offset=8)).reshape(n, dim)
     return np.frombuffer(body, "<f4", count=n * dim, offset=8).reshape(n, dim)
 
 
@@ -210,7 +263,7 @@ class EmbeddingParameterServer:
                         "a writer died mid-append; discarding the tail",
                         len(buf) - off - 4, rec_len)
                     break
-                name, rows, deltas = _unpack_request(
+                name, rows, deltas, _ = _unpack_request(
                     buf[off + 4:off + 4 + rec_len])
                 # same contract as the snapshot branch above: a journal
                 # written by a differently-configured server fails with
@@ -353,11 +406,15 @@ class EmbeddingParameterServer:
 
     def _post_timed(self, path, body):
         if path == "/pull.bin":
-            name, rows, _ = _unpack_request(body)
+            # the request's dtype tag asks which encoding the response
+            # payload should ride — bf16 halves the row-block bytes
+            name, rows, _, wire_dtype = _unpack_request(body)
             return 200, "application/octet-stream", _pack_rows(
-                self.pull(name, rows.tolist()))
+                self.pull(name, rows.tolist()), wire_dtype)
         if path == "/push.bin":
-            name, rows, deltas = _unpack_request(body)
+            # _unpack_request already widened a bf16 payload to f32 —
+            # accumulation (np.add.at in _apply) is always f32
+            name, rows, deltas, _ = _unpack_request(body)
             self.push(name, rows.tolist(), deltas)
             return 200, "application/octet-stream", b"ok"
         req = json.loads(body)
@@ -402,8 +459,17 @@ class EmbeddingPSClient:
                  timeout: float = 10.0, max_retries: int = 2,
                  retry_backoff: float = 0.05,
                  replay_capacity: int = 128,
-                 tenant: Optional[str] = None):
+                 tenant: Optional[str] = None,
+                 wire_dtype: str = "f32"):
         self.urls = [u.rstrip("/") for u in urls]
+        # opt-in narrow wire payload (mirrors the sharded trainer's
+        # grad_dtype="bf16"): row blocks ride bf16, ids stay i64,
+        # accumulation stays f32 server-side. NEVER default-on — the
+        # caller opts into the precision trade explicitly.
+        if wire_dtype not in ("f32", "bf16"):
+            raise ValueError(f"wire_dtype must be 'f32' or 'bf16', "
+                             f"got {wire_dtype!r}")
+        self.wire_dtype = wire_dtype
         # the identity this client's RPCs book under on the server side
         # (X-Tenant next to the traceparent). Explicit beats ambient:
         # the push drain runs on its own thread, where the fit loop's
@@ -440,6 +506,11 @@ class EmbeddingPSClient:
             "paramserver_client_push_replayed_total",
             "parked pushes delivered after their endpoint came back"
         ).labels()
+        self._m_wire = reg.counter(
+            "paramserver_wire_bytes_total",
+            "client-side request + response payload bytes by route — "
+            "the number wire_dtype='bf16' halves for row blocks",
+            ("route",))
         self._stop = threading.Event()
         # liveness: the drain holds a busy slot only while delivering a
         # push batch — a wedged endpoint (socket past its timeout, DNS
@@ -476,7 +547,9 @@ class EmbeddingPSClient:
                 # push drain's heartbeat exists for
                 _faults.fault_point("paramserver_rpc", route=label)
                 with urllib.request.urlopen(req, timeout=self.timeout) as r:
-                    return r.read()
+                    resp = r.read()
+                self._m_wire.labels(label).inc(len(payload) + len(resp))
+                return resp
             finally:
                 self._m_rpc.labels(label).inc()
                 self._m_rpc_sec.labels(label).observe(
@@ -530,28 +603,71 @@ class EmbeddingPSClient:
                 self._dims[k] = int(shape[1])
         return self._dims[table]
 
+    def _pull_shard(self, s: int, table: str, rows_sel: np.ndarray,
+                    deadline: Optional[float]) -> np.ndarray:
+        return _unpack_rows(self._post_with_retry(
+            self.urls[s], "/pull.bin",
+            _pack_request(table, rows_sel, wire_dtype=self.wire_dtype),
+            deadline=deadline))
+
     def pull(self, table: str, rows: np.ndarray,
              deadline_ms: Optional[float] = None) -> np.ndarray:
         """Fetch rows (grouped per owning shard, order restored). Empty
         row sets return a well-formed [0, dim] array. `deadline_ms`
         caps the retry spend across every shard RPC: past it, the
-        failure propagates instead of backing off further."""
+        failure propagates instead of backing off further.
+
+        The per-shard sub-pulls run CONCURRENTLY (one short-lived
+        `dl4j-ps-pull-*` thread per shard with rows): an S-shard table
+        costs ~max of the shard round trips, not their sum. Each thread
+        keeps the full per-endpoint retry/backoff/deadline semantics
+        (`_post_with_retry`), and the caller's span context is attached
+        so the per-shard `ps/client/pull.bin` spans stay inside the
+        calling step's trace. The threads are joined before return —
+        nothing outlives the call."""
         deadline = (None if deadline_ms is None
                     else time.monotonic() + float(deadline_ms) / 1e3)
         rows = np.asarray(rows, np.int64)
         if rows.size == 0:
             return np.zeros((0, self._dim(table)), np.float32)
+        sels = [(s, sel) for s, sel in
+                ((s, np.nonzero(rows % len(self.urls) == s)[0])
+                 for s in range(len(self.urls)))
+                if sel.size]
         out: Optional[np.ndarray] = None
-        for s, url in enumerate(self.urls):
-            sel = np.nonzero(rows % len(self.urls) == s)[0]
-            if sel.size == 0:
-                continue
-            got = _unpack_rows(self._post_with_retry(
-                url, "/pull.bin", _pack_request(table, rows[sel]),
-                deadline=deadline))
-            if out is None:
-                out = np.zeros((rows.size, got.shape[1]), np.float32)
+        if len(sels) == 1:  # one owner: no thread overhead
+            s, sel = sels[0]
+            got = self._pull_shard(s, table, rows[sel], deadline)
+            out = np.zeros((rows.size, got.shape[1]), np.float32)
             out[sel] = got
+        else:
+            ctx = _tracing.current_context()
+            results: List[Optional[np.ndarray]] = [None] * len(sels)
+            errors: List[BaseException] = []
+
+            def one(i: int, s: int, sel: np.ndarray) -> None:
+                try:
+                    with _tracing.attached_ctx(ctx):
+                        results[i] = self._pull_shard(
+                            s, table, rows[sel], deadline)
+                except Exception as e:
+                    errors.append(e)
+
+            threads = [threading.Thread(
+                target=one, args=(i, s, sel), daemon=True,
+                name=f"dl4j-ps-pull-{s}")
+                for i, (s, sel) in enumerate(sels)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            if errors:  # same contract as the old serial walk: the
+                # first shard failure propagates to the caller
+                raise errors[0]
+            for (s, sel), got in zip(sels, results):
+                if out is None:
+                    out = np.zeros((rows.size, got.shape[1]), np.float32)
+                out[sel] = got
         self._dims.setdefault(table, int(out.shape[1]))
         return out
 
@@ -633,7 +749,8 @@ class EmbeddingPSClient:
             # ITS payload, so a parked push replayed while a newer item
             # drains still reports under the trace that produced it
             self._pending[s].append(
-                [_pack_request(table, rows[sel], deltas[sel]), False, ctx])
+                [_pack_request(table, rows[sel], deltas[sel],
+                               wire_dtype=self.wire_dtype), False, ctx])
             self._flush_endpoint(s)
 
     def _flush_endpoint(self, s: int):
@@ -688,16 +805,37 @@ class EmbeddingPSClient:
             finally:
                 self._q.task_done()
 
-    def flush(self, timeout: float = 30.0):
-        """Wait for the QUEUED pushes to be attempted. Parked pushes
-        (endpoint down) are excluded — they wait for the endpoint, not
-        for this call; `pending_pushes()` exposes them."""
-        import time
+    def flush(self, timeout: float = 30.0) -> bool:
+        """Wait until every push queued BEFORE this call has been
+        ATTEMPTED (delivered or parked), bounded by `timeout`. Returns
+        True when the drain caught up, False on timeout.
 
+        This waits on the queue's unfinished-task count, NOT emptiness:
+        the last item leaves the queue before its POST lands, so an
+        emptiness poll lets a caller read tables the final delta has not
+        reached yet (the RemoteUIStatsStorageRouter bug class, PR 8).
+        And unlike a bare `Queue.join()`, the wait is bounded — a drain
+        thread that died with items still queued (task_done never runs)
+        or an endpoint wedged past its socket timeout makes this return
+        False at the deadline instead of hanging forever past the
+        advertised timeout. Parked pushes (endpoint down) are excluded —
+        they wait for the endpoint, not for this call; `pending_pushes()`
+        exposes them."""
         deadline = time.monotonic() + timeout
-        while not self._q.empty() and time.monotonic() < deadline:
-            time.sleep(0.02)
-        self._q.join()
+        q = self._q
+        with q.all_tasks_done:
+            while q.unfinished_tasks:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                q.all_tasks_done.wait(remaining)
+        return True
+
+    def queued_pushes(self) -> int:
+        """Push batches enqueued but not yet fully attempted — includes
+        the in-flight item the drain is currently delivering (0 means
+        every accepted push has been delivered or parked)."""
+        return int(self._q.unfinished_tasks)
 
     def pending_pushes(self) -> int:
         """Push payloads parked for replay across all endpoints."""

@@ -392,6 +392,17 @@ def note_wire(tenant, tier: str, nbytes: int) -> None:
     m.charge_wire(tenant, tier, nbytes)
 
 
+def note_ps_pull(tenant, seconds: float) -> None:
+    """Book sparse-embedding pull wall time (the wire wait the pipeline
+    could not hide) against `tenant` under the paramserver tier — the
+    time axis next to the wire bytes `note_wire` already books server-
+    side. No-op until a meter is enabled."""
+    m = _METER
+    if m is None:
+        return
+    m.charge_device_seconds(tenant, TIER_PARAMSERVER, seconds)
+
+
 def note_hbm(tenant, source: str, nbytes: float) -> None:
     m = _METER
     if m is None:

@@ -83,6 +83,24 @@ else
     exit 1
 fi
 
+# -- recsys sparse-pipeline smoke ---------------------------------------------
+# The sparse-embedding tier (parallel/sparse over the sharded
+# paramserver): tiny table, 2 in-process endpoints, zipf ids, a few
+# pipelined steps — asserting the cache books conserve (pull_rows ==
+# cache_hit + cache_miss), the prefetch-on trajectory is byte-identical
+# to the synchronous one (cache + prefetch are transparent), and zero
+# dl4j-sparse-* threads survive close().
+rm -f /tmp/_t1_recsys.log
+if timeout -k 10 180 env JAX_PLATFORMS=cpu \
+    python -m deeplearning4j_tpu.parallel.sparse --smoke \
+    > /tmp/_t1_recsys.log 2>&1; then
+    echo "T1 RECSYS SMOKE: ok (2 endpoints, zipf ids, books conserve, prefetch == sync)"
+else
+    echo "T1 RECSYS SMOKE: FAILED — tail of /tmp/_t1_recsys.log:"
+    tail -20 /tmp/_t1_recsys.log
+    exit 1
+fi
+
 # -- kernel-coverage smoke ----------------------------------------------------
 # The 53/53 contract (analysis/kernelcoverage.py): every ResNet-50 conv
 # instance must resolve to covered or declined-with-roofline-verdict in

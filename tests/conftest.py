@@ -151,9 +151,12 @@ def _live_pipeline_threads():
     # joins the thread). The session-scoped T1_LEDGER_DUMP ledger is
     # exempt — it deliberately spans the whole run.
     session_ledger_thread = getattr(_t1_ledger, "_thread", None)
+    # dl4j-sparse-* (parallel/sparse prefetch workers) are held to the
+    # same contract: SparseEmbeddingPipeline.close() joins its worker
     return sorted(((t, t.name) for t in threading.enumerate()
                    if (t.name.startswith(PIPELINE_THREAD_PREFIX)
-                       or t.name.startswith("dl4j-ledger"))
+                       or t.name.startswith("dl4j-ledger")
+                       or t.name.startswith("dl4j-sparse"))
                    and t is not session_ledger_thread
                    and t.is_alive()
                    and t not in _REPORTED_LEAKED_THREADS),

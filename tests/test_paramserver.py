@@ -144,3 +144,145 @@ def test_binary_payload_throughput():
         assert rate > 20, rate  # raw-bytes floor; JSON path was ~an order under
     finally:
         server.stop()
+
+
+def test_three_endpoint_pull_runs_shards_concurrently():
+    """A pull spanning 3 endpoints with an injected per-RPC latency must
+    take ~max(latencies), not their sum — the per-shard sub-pulls run on
+    concurrent threads (serial would be >= 3x the injected latency)."""
+    import time
+
+    from deeplearning4j_tpu.utils import faultpoints as fp
+
+    rng = np.random.default_rng(2)
+    t0 = rng.standard_normal((9, 4)).astype(np.float32)
+    servers = [EmbeddingParameterServer({"syn0": t0.copy()})
+               for _ in range(3)]
+    ports = [s.start() for s in servers]
+    try:
+        client = EmbeddingPSClient(
+            [f"http://127.0.0.1:{p}" for p in ports])
+        rows = np.arange(9)  # 3 rows per modulo-owner
+        client.pull("syn0", rows)  # warm connections / interpreter
+        lat_ms = 150.0
+        plan = fp.FaultPlan(seed=0)
+        plan.add("paramserver_rpc", "latency", p=1.0, latency_ms=lat_ms)
+        with fp.active(plan):
+            start = time.perf_counter()
+            got = client.pull("syn0", rows)
+            wall = time.perf_counter() - start
+        np.testing.assert_allclose(got, t0[rows], rtol=1e-6)
+        # serial sub-pulls would take >= 3 * 150ms = 450ms
+        assert wall < 2.0 * lat_ms / 1e3, \
+            f"3-shard pull took {wall * 1e3:.0f}ms — shards ran serially?"
+        assert wall >= 0.9 * lat_ms / 1e3, \
+            f"pull took {wall * 1e3:.0f}ms — latency fault did not fire?"
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def test_flush_waits_for_inflight_post():
+    """Regression: flush() must not return once the queue LOOKS empty —
+    the drain thread dequeues an item before POSTing it, so there is a
+    window where qsize()==0 but the delta has not landed. Inject a slow
+    network and read the server's table directly (no RPC) the moment
+    flush returns True."""
+    server = EmbeddingParameterServer({"syn0": np.zeros((4, 3), np.float32)})
+    port = server.start()
+    try:
+        from deeplearning4j_tpu.utils import faultpoints as fp
+
+        client = EmbeddingPSClient([f"http://127.0.0.1:{port}"])
+        plan = fp.FaultPlan(seed=0)
+        plan.add("paramserver_rpc", "latency", p=1.0, latency_ms=400.0)
+        with fp.active(plan):
+            client.push_async("syn0", np.array([1]),
+                              np.ones((1, 3), np.float32))
+            assert client.flush(timeout=10.0) is True
+            # no flush/pull between: the POST must ALREADY be applied
+            assert server.tables["syn0"][1, 0] == 1.0
+        assert client.dropped_pushes == 0
+    finally:
+        server.stop()
+
+
+def test_flush_timeout_returns_false_on_wedged_endpoint():
+    """flush(timeout=) is a bounded wait, not a hang: a wedged endpoint
+    (socket that accepts and never answers) makes flush return False
+    within ~the timeout; once the endpoint recovers the queued push
+    still drains and a later flush returns True."""
+    import time
+
+    from deeplearning4j_tpu.utils import faultpoints as fp
+
+    server = EmbeddingParameterServer({"syn0": np.zeros((4, 3), np.float32)})
+    port = server.start()
+    try:
+        client = EmbeddingPSClient([f"http://127.0.0.1:{port}"])
+        plan = fp.FaultPlan(seed=0)
+        plan.add("paramserver_rpc", "hang", p=1.0, hang_seconds=3.0,
+                 max_fires=1)
+        with fp.active(plan):
+            client.push_async("syn0", np.array([0]),
+                              np.ones((1, 3), np.float32))
+            start = time.perf_counter()
+            ok = client.flush(timeout=0.5)
+            wall = time.perf_counter() - start
+        assert ok is False
+        assert wall < 2.5, f"flush(timeout=0.5) blocked {wall:.1f}s"
+        # exiting the fault context releases the hang — the drain thread
+        # finishes the POST and a real flush succeeds
+        assert client.flush(timeout=10.0) is True
+        assert server.tables["syn0"][0, 0] == 1.0
+    finally:
+        server.stop()
+
+
+def test_bf16_wire_is_opt_in_halves_bytes_and_round_trips():
+    """wire_dtype='bf16' halves row-block wire bytes (counter-verified),
+    round-trips within bf16 tolerance, and accumulates in f32 on the
+    server (a bf16-exact delta lands exactly). Never default-on."""
+    import pytest
+
+    from deeplearning4j_tpu.utils.metrics import get_registry
+
+    rng = np.random.default_rng(3)
+    dim, n = 32, 64
+    t0 = rng.standard_normal((n, dim)).astype(np.float32)
+    server = EmbeddingParameterServer({"syn0": t0.copy()})
+    port = server.start()
+    url = f"http://127.0.0.1:{port}"
+    try:
+        with pytest.raises(ValueError):
+            EmbeddingPSClient([url], wire_dtype="fp8")
+        c32 = EmbeddingPSClient([url])
+        assert c32.wire_dtype == "f32"  # bf16 is strictly opt-in
+        c16 = EmbeddingPSClient([url], wire_dtype="bf16")
+
+        def pull_wire_bytes():
+            vals = get_registry().scalar_values()
+            return sum(v for k, v in vals.items()
+                       if k.startswith("paramserver_wire_bytes_total")
+                       and 'route="pull.bin"' in k)
+
+        rows = np.arange(n)
+        b0 = pull_wire_bytes()
+        exact = c32.pull("syn0", rows)
+        b1 = pull_wire_bytes()
+        approx = c16.pull("syn0", rows)
+        b2 = pull_wire_bytes()
+        np.testing.assert_allclose(exact, t0, rtol=1e-6)
+        np.testing.assert_allclose(approx, t0, rtol=1e-2, atol=1e-2)
+        # response payload is 2 bytes/element vs 4; requests are equal
+        f32_bytes, bf16_bytes = b1 - b0, b2 - b1
+        assert 0 < bf16_bytes < 0.65 * f32_bytes, (f32_bytes, bf16_bytes)
+
+        # server-side accumulation is f32: a delta exactly representable
+        # in bf16 (0.5) applies exactly even over the narrow wire
+        c16.push_async("syn0", rows, np.full((n, dim), 0.5, np.float32))
+        assert c16.flush(timeout=10.0) is True
+        np.testing.assert_allclose(server.tables["syn0"], t0 + 0.5,
+                                   rtol=1e-6)
+    finally:
+        server.stop()

@@ -1,0 +1,275 @@
+"""Overlapped sparse-embedding pipeline (parallel/sparse.py): book
+conservation, byte-identical prefetch-on/off trajectories, deadlines
+honored through the cache, thread hygiene, analyzer host-residency
+exemptions (JX005/JX008), and per-tenant pull spend."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data.recsys import zipf_ids
+from deeplearning4j_tpu.parallel.paramserver import (
+    EmbeddingParameterServer,
+    EmbeddingPSClient,
+)
+from deeplearning4j_tpu.parallel.sparse import (
+    SPARSE_THREAD_PREFIX,
+    SparseEmbeddingPipeline,
+)
+from deeplearning4j_tpu.utils import faultpoints as fp
+
+
+def _sparse_threads():
+    return [t.name for t in threading.enumerate()
+            if t.name.startswith(SPARSE_THREAD_PREFIX)]
+
+
+def _start_servers(init, n):
+    servers = [EmbeddingParameterServer({"emb": init.copy()})
+               for _ in range(n)]
+    urls = [f"http://127.0.0.1:{s.start()}" for s in servers]
+    return servers, urls
+
+
+def _run_arm(init, batches, *, prefetch, cache_rows, lr=0.1):
+    """One training arm over fresh 2-endpoint servers; returns the final
+    table (pulled after a full flush) and the pipeline's stats dict."""
+    servers, urls = _start_servers(init, 2)
+    try:
+        client = EmbeddingPSClient(urls)
+        try:
+            with SparseEmbeddingPipeline(client, "emb",
+                                         cache_rows=cache_rows,
+                                         prefetch=prefetch) as pipe:
+                for k, ids in enumerate(batches):
+                    rows = pipe.lookup(ids)
+                    if k + 1 < len(batches):
+                        pipe.prefetch(batches[k + 1])
+                    pipe.push(ids, (-lr * rows).astype(np.float32))
+                stats = pipe.stats()
+            assert client.flush(timeout=30.0) is True
+            final = client.pull("emb", np.arange(init.shape[0]))
+        finally:
+            client.close()
+    finally:
+        for s in servers:
+            s.stop()
+    return final, stats
+
+
+def test_books_conserve_and_duplicates_coalesce():
+    """pull_rows == cache_hit + cache_miss exactly, and duplicate ids in
+    a batch are coalesced (counted, pulled once)."""
+    rng = np.random.default_rng(0)
+    init = rng.standard_normal((64, 8)).astype(np.float32)
+    # heavy duplication: 48 ids over a 16-id range
+    batches = [rng.integers(0, 16, size=48) for _ in range(5)]
+    final, stats = _run_arm(init, batches, prefetch=True, cache_rows=32)
+    assert stats["pull_rows"] == stats["cache_hit"] + stats["cache_miss"], \
+        stats
+    assert stats["coalesced"] > 0, stats
+    assert stats["cache_hit"] > 0, stats  # repeated ids hit the hot cache
+    assert final.shape == init.shape
+
+
+def test_lookup_returns_rows_in_order_with_duplicates():
+    rng = np.random.default_rng(1)
+    init = rng.standard_normal((32, 4)).astype(np.float32)
+    servers, urls = _start_servers(init, 2)
+    try:
+        client = EmbeddingPSClient(urls)
+        try:
+            with SparseEmbeddingPipeline(client, "emb",
+                                         cache_rows=8) as pipe:
+                ids = np.array([5, 0, 5, 31, 0])
+                got = pipe.lookup(ids)
+                np.testing.assert_allclose(got, init[ids], rtol=1e-6)
+                # second lookup of the same ids is all cache hits
+                got2 = pipe.lookup(ids)
+                np.testing.assert_allclose(got2, init[ids], rtol=1e-6)
+                s = pipe.stats()
+                assert s["cache_hit"] == 3 and s["cache_miss"] == 3, s
+        finally:
+            client.close()
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def test_prefetch_on_off_trajectories_byte_identical():
+    """The acceptance bar: cache + prefetch + write-through must be
+    TRANSPARENT — same batches, same updates, byte-identical final
+    table with the pipeline on vs the synchronous no-cache arm."""
+    rng = np.random.default_rng(2)
+    init = (rng.standard_normal((48, 6)) * 0.5).astype(np.float32)
+    batches = [zipf_ids(24, 48, alpha=1.3, seed=100 + k)
+               for k in range(8)]
+    on, s_on = _run_arm(init, batches, prefetch=True, cache_rows=12)
+    off, s_off = _run_arm(init, batches, prefetch=False, cache_rows=0)
+    assert on.tobytes() == off.tobytes(), \
+        (np.abs(on - off).max(), s_on, s_off)
+    assert s_on["pull_rows"] == s_on["cache_hit"] + s_on["cache_miss"]
+    assert s_off["cache_hit"] == 0  # the alternate arm really is cold
+
+
+def test_deadline_honored_through_cache_and_under_outage():
+    """A wedged endpoint must not stall lookup() past deadline_ms even
+    when the rows were prefetched; fully-cached lookups still serve
+    (no RPC on the hot path) while the endpoint hangs."""
+    rng = np.random.default_rng(3)
+    init = rng.standard_normal((32, 4)).astype(np.float32)
+    servers, urls = _start_servers(init, 1)
+    try:
+        client = EmbeddingPSClient(urls)
+        try:
+            with SparseEmbeddingPipeline(client, "emb",
+                                         cache_rows=32) as pipe:
+                warm = np.arange(8)
+                pipe.lookup(warm)  # fill the cache before the outage
+                plan = fp.FaultPlan(seed=0)
+                plan.add("paramserver_rpc", "hang", p=1.0,
+                         hang_seconds=3.0)
+                cold = np.arange(16, 24)
+                with fp.active(plan):
+                    # cached rows: zero RPCs, deadline trivially met
+                    got = pipe.lookup(warm, deadline_ms=500)
+                    np.testing.assert_allclose(got, init[warm], rtol=1e-6)
+                    # cold rows ride a prefetch that is now wedged
+                    pipe.prefetch(cold)
+                    start = time.monotonic()
+                    with pytest.raises(TimeoutError):
+                        pipe.lookup(cold, deadline_ms=300)
+                    wall = time.monotonic() - start
+                    assert wall < 2.0, f"deadline overshot: {wall:.1f}s"
+                # endpoint recovered: the same rows resolve inline
+                got = pipe.lookup(cold)
+                np.testing.assert_allclose(got, init[cold], rtol=1e-6)
+        finally:
+            client.close()
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def test_push_write_through_keeps_cache_coherent():
+    """A push to a cached row updates the cached copy in place — the
+    next lookup returns the post-update value from cache, and after a
+    flush the server agrees."""
+    init = np.zeros((16, 4), np.float32)
+    servers, urls = _start_servers(init, 2)
+    try:
+        client = EmbeddingPSClient(urls)
+        try:
+            with SparseEmbeddingPipeline(client, "emb",
+                                         cache_rows=16) as pipe:
+                ids = np.array([2, 3])
+                pipe.lookup(ids)
+                pipe.push(ids, np.ones((2, 4), np.float32))
+                got = pipe.lookup(ids)  # served write-through, no flush
+                np.testing.assert_allclose(got, np.ones((2, 4)), rtol=1e-6)
+            assert client.flush(timeout=30.0) is True
+            final = client.pull("emb", ids)
+            np.testing.assert_allclose(final, np.ones((2, 4)), rtol=1e-6)
+        finally:
+            client.close()
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def test_close_leaves_no_sparse_threads():
+    init = np.zeros((8, 2), np.float32)
+    servers, urls = _start_servers(init, 1)
+    try:
+        client = EmbeddingPSClient(urls)
+        try:
+            pipe = SparseEmbeddingPipeline(client, "emb", cache_rows=4)
+            pipe.lookup(np.array([0, 1]))
+            pipe.prefetch(np.array([2, 3]))
+            assert _sparse_threads()  # the prefetch worker is live
+            pipe.close()
+            pipe.close()  # idempotent
+            assert not _sparse_threads(), _sparse_threads()
+            with pytest.raises(RuntimeError):
+                pipe.lookup(np.array([0]))
+            with pytest.raises(RuntimeError):
+                pipe.prefetch(np.array([0]))
+        finally:
+            client.close()
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def test_jx008_host_resident_table_exempt_device_side_fails():
+    """The regression the analyzers satellite demands: a multi-x-HBM
+    embedding table marked host_resident passes residency (JX008), the
+    SAME table device-side still fails."""
+    from deeplearning4j_tpu.analysis import costmodel as cmod
+    from deeplearning4j_tpu.models.recsys import recsys_network
+
+    hbm = 16 * 2 ** 20  # 16 MiB "chip"; the table below is 25.6 MB
+    vocab, dim = 100_000, 64
+
+    host = recsys_network(vocab=vocab, dim=dim, hidden=16,
+                          host_resident=True)
+    cm_host = cmod.train_step_cost(host, batch_size=8)
+    assert cm_host.host_resident_param_bytes >= vocab * dim * 4
+    assert cmod.residency_findings(cm_host, hbm_bytes=hbm) == []
+
+    dev = recsys_network(vocab=vocab, dim=dim, hidden=16,
+                         host_resident=False)
+    cm_dev = cmod.train_step_cost(dev, batch_size=8)
+    assert cm_dev.host_resident_param_bytes == 0
+    found = cmod.residency_findings(cm_dev, hbm_bytes=hbm)
+    assert [f.code for f in found] == ["JX008"], found
+
+
+def test_jx005_quiet_on_host_resident_table():
+    """The host-resident table's rows enter the jitted step as data, not
+    as a traced parameter — the dead-arg audit (JX005) must not flag the
+    table (or anything else in the recsys tower)."""
+    from deeplearning4j_tpu.analysis.jaxpr_audit import audit_network
+    from deeplearning4j_tpu.models.recsys import recsys_network
+
+    net = recsys_network(vocab=4096, dim=16, hidden=16,
+                         host_resident=True)
+    findings = audit_network(net, batch_size=4)
+    assert not [f for f in findings if f.code == "JX005"], findings
+
+
+def test_pull_spend_books_to_tenant_under_paramserver_tier():
+    from deeplearning4j_tpu.utils import resourcemeter
+    from deeplearning4j_tpu.utils.metrics import get_registry
+
+    tenant = "sparse-spend-test"
+
+    def tier_spend():
+        spend = resourcemeter.spend_table(get_registry().scalar_values())
+        return (spend.get(tenant, {}).get("device_seconds", {})
+                .get(resourcemeter.TIER_PARAMSERVER, 0.0))
+
+    resourcemeter.enable()
+    try:
+        before = tier_spend()
+        init = np.zeros((32, 4), np.float32)
+        servers, urls = _start_servers(init, 2)
+        try:
+            client = EmbeddingPSClient(urls, tenant=tenant)
+            try:
+                with SparseEmbeddingPipeline(client, "emb", cache_rows=8,
+                                             tenant=tenant) as pipe:
+                    pipe.lookup(np.arange(16))
+            finally:
+                client.close()
+        finally:
+            for s in servers:
+                s.stop()
+        after = tier_spend()
+        assert after > before, (before, after)
+        verdict = resourcemeter.conservation(get_registry().scalar_values())
+        assert verdict["ok"], verdict
+    finally:
+        resourcemeter.disable()
