@@ -88,4 +88,13 @@ def configure_logging(level=_logging.INFO, json_lines: bool = False,
     return logger
 
 
+import os as _os
+
+if _os.environ.get("DL4J_LOCKCHECK", "") == "1":
+    # arm the lock-order sanitizer BEFORE any framework module runs its
+    # module-level lock constructions (utils.metrics, utils.health) so
+    # those locks are traced too; off-path cost is zero — the import
+    # below is what patches, and it only happens under the env flag
+    from deeplearning4j_tpu.utils import locktrace as _locktrace  # noqa: F401
+
 from deeplearning4j_tpu.common.dtypes import PrecisionPolicy, default_policy

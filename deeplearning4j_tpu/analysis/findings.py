@@ -56,6 +56,20 @@ concurrency lint (AST over the repo itself):
   CC005  lock-order cycle across nested `with <lock>:` scopes
   CC006  stray print() in library code (use the package logger)
   CC007  time.time() in deadline/timeout arithmetic (use monotonic)
+
+concurrency audit (analysis/concurrency_audit: the runtime lock-order
+sanitizer in utils/locktrace merged with the lexical lock pass above;
+armed by DL4J_LOCKCHECK=1):
+  CN001  lock-order cycle in the merged (static + runtime) lock-order
+         graph — two code paths acquire the same locks in conflicting
+         orders; a runtime cycle carries BOTH witness stacks (error)
+  CN002  blocking call while holding a lock — time.sleep, queue
+         get/put, Condition/Event wait on another lock, Thread.join,
+         socket/HTTP I/O, block_until_ready/device sync (warning;
+         gated by name against scripts/lock_baseline.txt in the
+         `T1 LOCK AUDIT` step, not by the lint ERROR gate)
+  CN003  lock held across a jitted dispatch — the fit step or decode
+         engine step entered with a traced lock held (warning)
 """
 
 from __future__ import annotations

@@ -17,8 +17,10 @@ prove:
          dumps; the dl4j-* naming convention is enforced
   CC004  thread neither daemon nor joined in its creating scope — can
          hold the interpreter alive on exit
-  CC005  lock-order cycle: nested `with <lock>:` scopes acquiring locks
-         in conflicting orders across the module (static deadlock)
+  CC005  lock-order cycle: nested lock scopes — `with <lock>:` AND the
+         `acquire()`/`try`/`finally`/`release()` call form, including
+         Condition-guarded locks — acquiring locks in conflicting
+         orders across the module (static deadlock)
   CC006  print() in library code — the deeplearning4j_tpu logger is the
          only sanctioned channel (cli.py and bench.py are operator
          surfaces and exempt)
@@ -29,6 +31,18 @@ prove:
          calls `time.time()` and mentions a deadline-ish identifier
          (deadline/timeout/expire/remaining/retry_after...); plain
          timestamping (`"ts": time.time()`) stays legal.
+
+The pass also feeds the concurrency-audit vocabulary (CN codes, see
+analysis/concurrency_audit) where a finding is detectable without
+running:
+
+  CN002  blocking call lexically inside a held lock scope —
+         time.sleep, queue get/put, a Condition/Event wait on *another*
+         lock, Thread.join, socket/HTTP I/O, block_until_ready
+         (WARNING: the runtime sanitizer is the authority; the lexical
+         hit is the early warning)
+  CN003  jitted-dispatch-shaped call (step_fn/fit_fn/*_jit) entered
+         with a lock held (WARNING)
 
 Findings carry stable names (`CODE:path:scope[#n]`, no line numbers) so
 scripts/lint.sh can diff them against the committed
@@ -50,6 +64,7 @@ from typing import Dict, List, Optional, Set, Tuple
 
 from deeplearning4j_tpu.analysis.findings import (
     ERROR,
+    WARNING,
     Finding,
     error_names,
     format_findings,
@@ -59,13 +74,28 @@ from deeplearning4j_tpu.analysis.findings import (
 DEFAULT_TARGETS = ("deeplearning4j_tpu", "bench.py")
 # operator surfaces whose stdout IS the interface (lint.py's own CLI
 # output included — it is what scripts/lint.sh reads)
-PRINT_EXEMPT_BASENAMES = ("cli.py", "bench.py", "lint.py")
+PRINT_EXEMPT_BASENAMES = ("cli.py", "bench.py", "lint.py",
+                          "concurrency_audit.py")
 THREAD_NAME_PREFIX = "dl4j-"
 
 # receiver heuristic for queue ops: the last attribute/name segment, sans
 # leading underscores, is queue-ish ("q", "queue", "handoff", "*_q", ...)
 _QUEUE_NAME = re.compile(r"^_*(q|queue|handoff|.*_q|.*_queue|.*_handoff)$")
 _LOCK_NAME = re.compile(r"(^|_)(lock|mutex)s?$", re.IGNORECASE)
+# Condition-ish receivers guard a lock: `with self._wake:` acquires the
+# underlying lock exactly like `with self._lock:` does, so they join
+# the same lock-order graph (and `<cond>.wait()` releases only its OWN
+# lock — waiting while another lock is held is a CN002)
+_CONDISH = re.compile(
+    r"(^|_)(cond|cv|condition|wake|not_empty|not_full|all_tasks_done)s?$",
+    re.IGNORECASE)
+# Event-ish receivers: `.wait()` on one of these blocks without
+# releasing anything — always a CN002 under a held lock
+_EVENTISH = re.compile(
+    r"(^|_)(event|evt)s?$|(^|_)stop(ped)?$|(^|_)(done|ready)$",
+    re.IGNORECASE)
+# jitted-dispatch-shaped callables for the static CN003 heuristic
+_JIT_FN = re.compile(r"(^|_)(step_fn|fit_fn|train_fn)$|jitted|_jit$")
 # identifiers that mark a statement as deadline/timeout arithmetic
 # (CC007): a `time.time()` in the same statement is wall-clock math on
 # a duration contract
@@ -144,13 +174,37 @@ def _blocking_without_timeout(node: ast.Call, is_get: bool) -> bool:
 
 
 def _lock_source(node: ast.expr) -> Optional[str]:
-    """Dotted source of a lock-ish with-context expression, or None."""
+    """Dotted source of a lock-ish (or Condition-ish — a Condition
+    guards a lock) expression, or None."""
     try:
         src = ast.unparse(node)
     except Exception:
         return None
     last = src.split(".")[-1].split("(")[0]
-    return src if _LOCK_NAME.search(last) else None
+    if _LOCK_NAME.search(last) or _CONDISH.search(last):
+        return src
+    return None
+
+
+def _is_eventish_receiver(node: ast.expr) -> bool:
+    if isinstance(node, ast.Attribute):
+        return bool(_EVENTISH.search(node.attr))
+    if isinstance(node, ast.Name):
+        return bool(_EVENTISH.search(node.id))
+    return False
+
+
+def _is_nonblocking_qcall(node: ast.Call, is_get: bool) -> bool:
+    """block=False (keyword or positional) — raises instead of blocking."""
+    block_kw = _kwarg(node, "block")
+    if isinstance(block_kw, ast.Constant) and block_kw.value is False:
+        return True
+    pos = 0 if is_get else 1
+    if len(node.args) > pos:
+        b = node.args[pos]
+        if isinstance(b, ast.Constant) and b.value is False:
+            return True
+    return False
 
 
 class _ModuleLinter(ast.NodeVisitor):
@@ -165,6 +219,11 @@ class _ModuleLinter(ast.NodeVisitor):
         self._class_stack: List[str] = []
         # module-wide lock-order edges: (a, b) -> first location
         self.lock_edges: Dict[Tuple[str, str], str] = {}
+        # `path:line` of a threading.Lock/RLock/Condition construction
+        # -> lexical lock key; lets concurrency_audit join the RUNTIME
+        # lock-order graph (keyed by construction site) with this
+        # lexical one (keyed by Class.attr)
+        self.lock_ctor_sites: Dict[str, str] = {}
         src = ast.dump(tree)
         self.runs_threads = ("Thread" in src) or any(
             isinstance(n, (ast.Import, ast.ImportFrom))
@@ -336,7 +395,87 @@ class _ModuleLinter(ast.NodeVisitor):
                     "code — wedges forever when the peer thread dies",
                     "use utils/concurrency.put_abortable/get_abortable "
                     "(or pass timeout= in a poll loop)")
+        # CC005 (call form): lock.acquire()/release() participate in the
+        # same lock-order graph as `with lock:` — the try/finally idiom
+        # was invisible to the lexical pass before
+        if isinstance(func, ast.Attribute) and func.attr == "acquire":
+            src = _lock_source(func.value)
+            if src is not None:
+                key = self._lock_key(src)
+                for held in self._lock_stack:
+                    if held != key:
+                        self.lock_edges.setdefault(
+                            (held, key), f"{self.rel}:{node.lineno}")
+                self._lock_stack.append(key)
+        elif isinstance(func, ast.Attribute) and func.attr == "release":
+            src = _lock_source(func.value)
+            if src is not None:
+                key = self._lock_key(src)
+                for i in range(len(self._lock_stack) - 1, -1, -1):
+                    if self._lock_stack[i] == key:
+                        del self._lock_stack[i]
+                        break
+        if self._lock_stack:
+            self._check_blocking_under_lock(node, func)
         self.generic_visit(node)
+
+    # -- CN002/CN003: blocking calls lexically under a held lock -------------
+
+    def _check_blocking_under_lock(self, node: ast.Call, func):
+        """Static half of the CN002/CN003 runtime probes (WARNING: the
+        sanitizer is the authority, this is the no-run early warning).
+        Waiting on a Condition that is itself on the lock stack is
+        exempt for its OWN lock — `with cond: cond.wait()` is THE
+        pattern — but still a finding when other locks stay held."""
+        held = sorted(set(self._lock_stack))
+        blocked = None
+        if isinstance(func, ast.Attribute):
+            attr = func.attr
+            if attr == "sleep" and isinstance(func.value, ast.Name) \
+                    and func.value.id == "time":
+                blocked = "time.sleep"
+            elif attr in ("get", "put") and _is_queue_receiver(func.value):
+                if not _is_nonblocking_qcall(node, is_get=attr == "get"):
+                    blocked = f"queue.{attr}"
+            elif attr == "wait":
+                src = _lock_source(func.value)
+                if src is not None:
+                    key = self._lock_key(src)
+                    others = sorted(set(k for k in self._lock_stack
+                                        if k != key))
+                    if others:
+                        blocked = "condition.wait"
+                        held = others
+                elif _is_eventish_receiver(func.value):
+                    blocked = "event.wait"
+            elif attr == "join" and _is_threadish_receiver(func.value):
+                blocked = "thread.join"
+            elif attr == "block_until_ready":
+                blocked = "device_sync"
+            elif attr in ("urlopen", "create_connection", "getresponse"):
+                blocked = "socket/http"
+        elif isinstance(func, ast.Name) and func.id == "urlopen":
+            blocked = "socket/http"
+        if blocked is not None:
+            self._emit(
+                "CN002", WARNING, node,
+                f"{blocked} while holding lock(s) {', '.join(held)} — "
+                "every peer contending for the lock stalls behind this "
+                "call (and it can deadlock against the thread that "
+                "would unblock it)",
+                "snapshot state under the lock, release, THEN block; "
+                "or baseline it in scripts/lock_baseline.txt with a "
+                "comment")
+            return
+        tgt = func.attr if isinstance(func, ast.Attribute) else (
+            func.id if isinstance(func, ast.Name) else None)
+        if tgt is not None and _JIT_FN.search(tgt):
+            self._emit(
+                "CN003", WARNING, node,
+                f"jitted dispatch {tgt}() entered while holding lock(s) "
+                f"{', '.join(held)} — the lock is held for a whole "
+                "device program (and a compile, on the first call)",
+                "stage inputs under the lock, dispatch outside it")
 
     def _daemon_assigned_nearby(self, call: ast.Call) -> bool:
         """True if the enclosing function also assigns `<x>.daemon = True`
@@ -360,6 +499,24 @@ class _ModuleLinter(ast.NodeVisitor):
                     and _is_threadish_receiver(sub.func.value):
                 return True
         return False
+
+    # -- lock construction sites (runtime-graph join points) ------------------
+
+    def visit_Assign(self, node):
+        v = node.value
+        if isinstance(v, ast.Call) and isinstance(v.func, ast.Attribute) \
+                and v.func.attr in ("Lock", "RLock", "Condition") \
+                and isinstance(v.func.value, ast.Name) \
+                and v.func.value.id == "threading":
+            for tgt in node.targets:
+                try:
+                    src = ast.unparse(tgt)
+                except Exception:
+                    continue
+                self.lock_ctor_sites[f"{self.rel}:{v.lineno}"] = \
+                    self._lock_key(src)
+                break
+        self.generic_visit(node)
 
     # -- CC005 lock-order edges ----------------------------------------------
 
@@ -442,13 +599,18 @@ def _py_files(paths) -> List[str]:
     return sorted(out)
 
 
-def lint_paths(paths=DEFAULT_TARGETS, base_dir: Optional[str] = None
-               ) -> List[Finding]:
-    """Lint files/directories; finding names are stable relative paths
-    rooted at `base_dir` (default: cwd)."""
+def collect(paths=DEFAULT_TARGETS, base_dir: Optional[str] = None):
+    """Lint files/directories, returning the full lexical harvest:
+    ``(findings, lock_edges, lock_ctor_sites)``. The extra two are what
+    analysis/concurrency_audit merges with the runtime lock-order graph
+    (edges -> static/runtime/both labels; ctor sites -> joining a
+    runtime ``path:line`` lock class to its lexical ``Class.attr``
+    key). Finding names are stable relative paths rooted at `base_dir`
+    (default: cwd)."""
     base = os.path.abspath(base_dir or os.getcwd())
     findings: List[Finding] = []
     lock_edges: Dict[Tuple[str, str], str] = {}
+    lock_ctor_sites: Dict[str, str] = {}
     for path in _py_files(paths):
         ap = os.path.abspath(path)
         rel = os.path.relpath(ap, base).replace(os.sep, "/")
@@ -464,6 +626,7 @@ def lint_paths(paths=DEFAULT_TARGETS, base_dir: Optional[str] = None
         linter.visit(tree)
         findings.extend(linter.findings)
         lock_edges.update(linter.lock_edges)
+        lock_ctor_sites.update(linter.lock_ctor_sites)
     for cycle, loc in _find_cycles(lock_edges):
         order = " -> ".join(cycle)
         findings.append(Finding(
@@ -472,7 +635,14 @@ def lint_paths(paths=DEFAULT_TARGETS, base_dir: Optional[str] = None
             "locks in conflicting orders (potential deadlock)",
             "pick one global order for these locks and stick to it",
             name="CC005:" + "->".join(sorted(set(cycle)))))
-    return findings
+    return findings, lock_edges, lock_ctor_sites
+
+
+def lint_paths(paths=DEFAULT_TARGETS, base_dir: Optional[str] = None
+               ) -> List[Finding]:
+    """Lint files/directories; finding names are stable relative paths
+    rooted at `base_dir` (default: cwd)."""
+    return collect(paths, base_dir)[0]
 
 
 def main(argv=None) -> int:

@@ -1120,6 +1120,27 @@ def cmd_doctor(args) -> int:
         print(f"wrote {args.json}")
     else:
         print(format_findings(findings))
+        # concurrency section: the repo-wide lock-discipline audit —
+        # lexical always, plus witnessed runtime edges when the
+        # DL4J_LOCKCHECK sanitizer is armed in this process. Display
+        # only: the gated form is scripts/t1.sh's `T1 LOCK AUDIT:` step
+        # (cli locks --smoke --baseline scripts/lock_baseline.txt)
+        try:
+            from deeplearning4j_tpu.analysis import (
+                concurrency_audit as _ca,
+            )
+
+            cdoc = _ca.report(runtime=True)
+            mode = ("static+runtime" if cdoc["runtime"]
+                    else "static only — arm with DL4J_LOCKCHECK=1")
+            print(f"concurrency: {len(cdoc['edges'])} lock-order "
+                  f"edge(s), {cdoc['summary']['errors']} error(s) / "
+                  f"{cdoc['summary']['warnings']} warning(s) [{mode}]")
+            if cdoc["findings"]:
+                print(format_findings(cdoc["findings"]))
+        except Exception as e:
+            print(f"concurrency: audit unavailable "
+                  f"({type(e).__name__}: {e})")
     return 1 if has_errors(findings) else 0
 
 
@@ -1636,6 +1657,19 @@ def cmd_chaos(args) -> int:
     else:
         plan = _chaos_default_plan(args.preset, args.seed or 0,
                                    steps=args.steps)
+    # the serving/decode rehearsals double as lock-sanitizer coverage:
+    # arm DL4J_LOCKCHECK for the run so the fault-riddled schedules
+    # (hangs, sheds, swap races) also witness lock-acquisition orders.
+    # Disarmed again afterwards — chaos runs in-process under pytest
+    # too, and the patches must not outlive the rehearsal there
+    lock_audit = None
+    lock_armed_here = False
+    if args.preset in ("serving", "decode"):
+        from deeplearning4j_tpu.utils import locktrace as _locktrace
+
+        if not _locktrace.enabled():
+            _locktrace.install()
+            lock_armed_here = True
     trace_out = args.trace_out
     if trace_out:
         prev_tracing = _tracing.is_enabled()
@@ -1655,6 +1689,24 @@ def cmd_chaos(args) -> int:
     finally:
         if trace_out:
             _tracing.enable(prev_tracing)
+        if args.preset in ("serving", "decode"):
+            # harvest the witnessed graph BEFORE disarming (and disarm
+            # even when the preset raised)
+            from deeplearning4j_tpu.analysis import (
+                concurrency_audit as _ca,
+            )
+
+            try:
+                cdoc = _ca.report(runtime=True)
+                lock_audit = {
+                    "edges": len(cdoc["edges"]),
+                    "errors": cdoc["summary"]["errors"],
+                    "warnings": cdoc["summary"]["warnings"],
+                    "findings": [f.name for f in cdoc["findings"]],
+                }
+            finally:
+                if lock_armed_here:
+                    _locktrace.uninstall()
     report = {
         "preset": args.preset,
         "plan": _json.loads(plan.to_json()),
@@ -1664,11 +1716,14 @@ def cmd_chaos(args) -> int:
     }
     if trace_out:
         report["trace"] = _chaos_trace_report(args.preset, trace_out)
+    if lock_audit is not None:
+        report["lock_audit"] = lock_audit
     ok = (report["outcome"] in ("recovered", "cleanly_failed")
           and report["conservation_ok"]
           and not report["unhealthy_components"]
           and report.get("loop_exercised", True)
-          and report.get("trace", {}).get("fault_trace_ok", True))
+          and report.get("trace", {}).get("fault_trace_ok", True)
+          and (lock_audit is None or lock_audit["errors"] == 0))
     report["verdict"] = "ok" if ok else "violated"
     if args.json == "-":
         print(_json.dumps(report, indent=2, default=str))
@@ -1700,6 +1755,11 @@ def cmd_chaos(args) -> int:
                      if report.get("final_score") is not None else ""))
         if report.get("failure"):
             print(f"  failure: {report['failure']}")
+        if lock_audit is not None:
+            print(f"  lock audit: {lock_audit['edges']} order edge(s), "
+                  f"{lock_audit['errors']} error(s) / "
+                  f"{lock_audit['warnings']} warning(s) (sanitizer "
+                  f"armed for the rehearsal)")
         if report.get("trace"):
             tr = report["trace"]
             print(f"  trace export: {tr['path']} "
@@ -1724,6 +1784,25 @@ def cmd_lint(args) -> int:
     if args.baseline:
         argv += ["--baseline", args.baseline]
     return lint_main(argv)
+
+
+def cmd_locks(args) -> int:
+    """Merged lock-discipline audit (analysis/concurrency_audit,
+    CN001-CN003): the lexical lock-order graph always; the runtime
+    sanitizer's witnessed edges too when it is armed (DL4J_LOCKCHECK=1)
+    or when --smoke runs the serving+decode+sparse exercise in-process.
+    scripts/t1.sh wraps the --smoke --baseline form as the
+    `T1 LOCK AUDIT:` gate."""
+    from deeplearning4j_tpu.analysis.concurrency_audit import main as ca_main
+
+    argv = []
+    if args.smoke:
+        argv.append("--smoke")
+    if args.json:
+        argv += ["--json", args.json]
+    if args.baseline:
+        argv += ["--baseline", args.baseline]
+    return ca_main(argv)
 
 
 def main(argv=None) -> int:
@@ -2065,6 +2144,22 @@ def main(argv=None) -> int:
                     help="suppress baselined ERROR names; exit 1 only on "
                          "new ones")
     ln.set_defaults(fn=cmd_lint)
+
+    lk = sub.add_parser(
+        "locks",
+        help="merged static+runtime lock-discipline audit "
+             "(analysis/concurrency_audit, CN001-CN003; "
+             "DL4J_LOCKCHECK=1 arms the runtime half)")
+    lk.add_argument("--smoke", action="store_true",
+                    help="arm the sanitizer and run the serving + decode "
+                         "+ sparse exercise before reporting")
+    lk.add_argument("--json", default=None, metavar="PATH",
+                    help="machine-readable report ('-' = stdout)")
+    lk.add_argument("--baseline", default=None, metavar="FILE",
+                    help="suppress baselined CN names "
+                         "(scripts/lock_baseline.txt); exit 1 only on "
+                         "new ones")
+    lk.set_defaults(fn=cmd_locks)
 
     args = ap.parse_args(argv)
     return args.fn(args)

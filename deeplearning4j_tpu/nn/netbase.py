@@ -29,6 +29,7 @@ from deeplearning4j_tpu.utils import blackbox as _blackbox
 from deeplearning4j_tpu.utils import devprof as _devprof
 from deeplearning4j_tpu.utils import faultpoints as _faults
 from deeplearning4j_tpu.utils import health as _health
+from deeplearning4j_tpu.utils import locktrace as _locktrace
 from deeplearning4j_tpu.utils import metrics as _metrics
 from deeplearning4j_tpu.utils import resourcemeter as _resourcemeter
 from deeplearning4j_tpu.utils import runledger as _runledger
@@ -587,6 +588,10 @@ class NetworkBase:
                 injected = _faults.fault_point("train_step")
                 if injected == "nan" and batches:
                     _faults.taint_nan(batches[0])
+                # CN003 probe: entering the jitted step with a traced
+                # lock held stalls every contender for a whole device
+                # program (off = one module-global read)
+                _locktrace.note_dispatch("fit/dispatch")
                 fit_fn()
             dispatch = time.perf_counter() - t0
             if _tracing.is_enabled() and self._score is not None:

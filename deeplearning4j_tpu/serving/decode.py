@@ -83,6 +83,7 @@ from deeplearning4j_tpu.parallel.inference import (
 from deeplearning4j_tpu.utils import blackbox as _blackbox
 from deeplearning4j_tpu.utils import faultpoints as _faults
 from deeplearning4j_tpu.utils import health as _health
+from deeplearning4j_tpu.utils import locktrace as _locktrace
 from deeplearning4j_tpu.utils import metrics as _metrics
 from deeplearning4j_tpu.utils import resourcemeter as _resourcemeter
 from deeplearning4j_tpu.utils import runledger as _runledger
@@ -751,6 +752,10 @@ class DecodeEngine:
             K = self._step_k
             try:
                 _faults.fault_point("decode_step", active=n_active)
+                # CN003 probe: the engine must never enter the jitted
+                # pool step holding the admission lock (off = one
+                # module-global read)
+                _locktrace.note_dispatch("decode/step")
                 with _tracing.span("decode/step", active=n_active,
                                    version=self._version):
                     if K == 1:

@@ -234,6 +234,15 @@ class FlightRecorder:
             tenants = resourcemeter.snapshot()
         except Exception:
             tenants = None
+        try:
+            # who holds what and who waits on whom (None unless the
+            # DL4J_LOCKCHECK sanitizer is armed): a watchdog-caught hang
+            # dumps as a NAMED wait-graph cycle, not a stack soup
+            from deeplearning4j_tpu.utils import locktrace
+
+            locks = locktrace.forensics()
+        except Exception:
+            locks = None
         return {
             "reason": reason,
             "ts": round(time.time(), 3),
@@ -246,6 +255,7 @@ class FlightRecorder:
             "metrics_deltas": deltas,
             "health": health,
             "tenants": tenants,
+            "locks": locks,
             "threads": thread_stacks(),
         }
 
@@ -527,6 +537,30 @@ def render_dump(doc: dict, max_steps: int = 32,
                              f"fail={b.get('failed', 0)}")
             lines.append(f"  {t}: " + ("  ".join(parts) if parts
                                        else "(idle)"))
+    locks_doc = doc.get("locks") or {}
+    if locks_doc.get("enabled"):
+        lines.append("")
+        held = locks_doc.get("held") or {}
+        waiting = locks_doc.get("waiting") or []
+        cycles = locks_doc.get("deadlock_cycles") or []
+        lines.append(f"lock forensics (DL4J_LOCKCHECK): "
+                     f"{sum(len(v) for v in held.values())} held, "
+                     f"{len(waiting)} waiting, {len(cycles)} deadlock "
+                     f"cycle(s)")
+        for tname in sorted(held):
+            locks_held = ", ".join(
+                f"{h['site']}" + (f" x{h['depth']}" if h.get("depth", 1) > 1
+                                  else "")
+                for h in held[tname])
+            lines.append(f"  {tname} holds: {locks_held}")
+        for w in waiting:
+            lines.append(f"  {w['thread']} waiting {w['waited_s']}s "
+                         f"for {w['waits_for']}")
+        for cyc in cycles:
+            lines.append("  DEADLOCK CYCLE:")
+            for e in cyc:
+                lines.append(f"    {e['thread']} waits for "
+                             f"{e['waits_for']} held by {e['held_by']}")
     threads = doc.get("threads") or []
     if threads:
         lines.append("")

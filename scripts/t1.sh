@@ -101,6 +101,28 @@ else
     exit 1
 fi
 
+# -- lock-order sanitizer smoke -----------------------------------------------
+# The concurrency audit (utils/locktrace + analysis/concurrency_audit):
+# serving + decode + sparse/paramserver run with DL4J_LOCKCHECK armed,
+# their witnessed lock-acquisition orders merged with the lexical lock
+# graph, and ALL CN001/CN002/CN003 finding names diffed against the
+# committed scripts/lock_baseline.txt (ideally empty). A new name means
+# a lock-order cycle, a blocking call under a lock, or a jitted
+# dispatch entered with a lock held crept into a mainline tier.
+rm -f /tmp/_t1_lockaudit.log /tmp/_t1_lock_findings.txt
+if timeout -k 10 420 env JAX_PLATFORMS=cpu DL4J_LOCKCHECK=1 \
+    python -m deeplearning4j_tpu.analysis.concurrency_audit --smoke --quiet \
+    --baseline scripts/lock_baseline.txt \
+    --names-out /tmp/_t1_lock_findings.txt \
+    > /tmp/_t1_lockaudit.log 2>&1; then
+    echo "T1 LOCK AUDIT: ok ($(grep -a '^lock audit:' /tmp/_t1_lockaudit.log | tail -1))"
+else
+    echo "T1 LOCK AUDIT: FAILED — tail of /tmp/_t1_lockaudit.log:"
+    tail -20 /tmp/_t1_lockaudit.log
+    echo "T1 LOCK AUDIT: finding names artifact: /tmp/_t1_lock_findings.txt"
+    exit 1
+fi
+
 # -- kernel-coverage smoke ----------------------------------------------------
 # The 53/53 contract (analysis/kernelcoverage.py): every ResNet-50 conv
 # instance must resolve to covered or declined-with-roofline-verdict in

@@ -1,0 +1,450 @@
+"""Concurrency audit tests: the runtime lock-order sanitizer
+(utils/locktrace), the merged static+runtime audit
+(analysis/concurrency_audit), deadlock forensics in blackbox dumps, and
+the lexical CC005/CN002 extensions in analysis/lint.
+
+The off-path contract is load-bearing: with DL4J_LOCKCHECK unset the
+whole subsystem must cost one module-global read, so the pins here are
+the same 10µs/call bar the metering and ledger hooks carry."""
+
+import os
+import queue
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.analysis import concurrency_audit as ca
+from deeplearning4j_tpu.analysis import lint
+from deeplearning4j_tpu.utils import locktrace
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(lint.__file__)))
+REPO = os.path.dirname(REPO)
+# a tiny, lock-free file for the static half: keeps report() fast in
+# tests that only exercise the runtime graph
+_SMALL_STATIC = [os.path.join(
+    REPO, "deeplearning4j_tpu", "analysis", "findings.py")]
+
+
+@pytest.fixture
+def armed():
+    """Arm the sanitizer for one test, restore the stdlib after."""
+    was = locktrace.enabled()
+    if not was:
+        locktrace.install()
+    locktrace.reset()
+    try:
+        yield
+    finally:
+        if not was:
+            locktrace.uninstall()
+
+
+# -- CN001: reversed acquisition order ----------------------------------------
+
+def test_reversed_order_is_cn001_with_both_witness_stacks(armed):
+    """The ISSUE fixture: two threads taking two locks in opposite
+    orders — no real contention needed, the order graph alone convicts,
+    and BOTH edges carry a stack witness naming this file."""
+    a = locktrace.traced_lock("fixA")
+    b = locktrace.traced_lock("fixB")
+
+    def forward():
+        with a:
+            with b:
+                pass
+
+    def backward():
+        with b:
+            with a:
+                pass
+
+    t1 = threading.Thread(target=forward, name="dl4j-lockfix-1")
+    t1.start()
+    t1.join(10)
+    t2 = threading.Thread(target=backward, name="dl4j-lockfix-2")
+    t2.start()
+    t2.join(10)
+    assert not t1.is_alive() and not t2.is_alive()
+
+    snap = locktrace.snapshot()
+    by_pair = {(e["src"], e["dst"]): e for e in snap["edges"]}
+    assert ("fixA", "fixB") in by_pair and ("fixB", "fixA") in by_pair
+    for pair in (("fixA", "fixB"), ("fixB", "fixA")):
+        witness = by_pair[pair]["witness"]
+        assert witness, f"edge {pair} has no witness stack"
+        assert any("test_concurrency_audit" in fr for fr in witness)
+
+    doc = ca.report(runtime=True, paths=_SMALL_STATIC, base_dir=REPO)
+    cn1 = [f for f in doc["findings"] if f.code == "CN001"
+           and "fixA" in f.name and "fixB" in f.name]
+    assert len(cn1) == 1
+    msg = cn1[0].message
+    assert msg.count("witness:") == 2, msg
+    assert "test_concurrency_audit" in msg
+    assert "[runtime]" in msg
+    assert cn1[0].name == "CN001:fixA->fixB"
+
+
+def test_consistent_order_is_clean(armed):
+    a = locktrace.traced_lock("okA")
+    b = locktrace.traced_lock("okB")
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    doc = ca.report(runtime=True, paths=_SMALL_STATIC, base_dir=REPO)
+    assert not [f for f in doc["findings"] if f.code == "CN001"]
+    assert any(e["src"] == "okA" and e["dst"] == "okB"
+               for e in doc["edges"])
+
+
+# -- deadlock forensics: the real wedge ---------------------------------------
+
+def test_real_wedge_forensics_named_and_rendered(armed, tmp_path, capsys):
+    """The same fixture wedged for REAL (bounded by acquire timeouts so
+    the threads always exit): the live wait-graph names the cycle, the
+    watchdog's degradation hook captures it, and `cli blackbox` renders
+    the DEADLOCK CYCLE section from the dump."""
+    a = locktrace.traced_lock("wedgeA")
+    b = locktrace.traced_lock("wedgeB")
+    a_held = threading.Event()
+    b_held = threading.Event()
+
+    def holder_a():
+        with a:
+            a_held.set()
+            b_held.wait(5)
+            if b.acquire(timeout=6):
+                b.release()
+
+    def holder_b():
+        with b:
+            b_held.set()
+            a_held.wait(5)
+            if a.acquire(timeout=6):
+                a.release()
+
+    t1 = threading.Thread(target=holder_a, name="dl4j-wedge-1")
+    t2 = threading.Thread(target=holder_b, name="dl4j-wedge-2")
+    t1.start()
+    t2.start()
+
+    cycle = None
+    deadline = time.monotonic() + 5.0
+    try:
+        while time.monotonic() < deadline:
+            fx = locktrace.forensics()
+            if fx and fx["deadlock_cycles"]:
+                cycle = fx["deadlock_cycles"][0]
+                break
+            time.sleep(0.02)
+        assert cycle is not None, "wait-graph never showed the cycle"
+        names = {e["thread"] for e in cycle}
+        assert names == {"dl4j-wedge-1", "dl4j-wedge-2"}
+        for e in cycle:
+            assert e["waits_for"] in ("wedgeA", "wedgeB")
+            assert e["held_by"] in names
+
+        # the watchdog's first-stall hook sees the same forensics
+        from deeplearning4j_tpu.utils import blackbox
+
+        rec = blackbox.get_recorder()
+        rec.on_degradation("lock-fixture", 1.0, ["dl4j-wedge-1"])
+        assert rec.last_degradation["locks"]["deadlock_cycles"]
+
+        dump = str(tmp_path / "wedge_dump.json")
+        rec.dump(dump, reason="deadlock fixture")
+    finally:
+        t1.join(15)
+        t2.join(15)
+    assert not t1.is_alive() and not t2.is_alive()
+
+    from deeplearning4j_tpu.cli import main as cli_main
+
+    assert cli_main(["blackbox", dump]) == 0
+    out = capsys.readouterr().out
+    assert "DEADLOCK CYCLE" in out
+    assert "dl4j-wedge-1" in out and "dl4j-wedge-2" in out
+    assert "waits for" in out and "held by" in out
+
+
+# -- CN002/CN003: runtime probes ----------------------------------------------
+
+def test_blocking_probes_fire_under_lock_only(armed):
+    lk = locktrace.traced_lock("probeL")
+    # no lock held: probes stay silent
+    time.sleep(0.001)
+    q = queue.Queue()
+    q.put(1)
+    q.get()
+    assert locktrace.snapshot()["blocking"] == []
+
+    with lk:
+        time.sleep(0.001)
+        with pytest.raises(queue.Empty):
+            q.get(timeout=0.01)
+        q.put(2)
+        locktrace.note_blocking("custom.rpc")
+        locktrace.note_dispatch("fixture/step")
+    snap = locktrace.snapshot()
+    kinds = {b["kind"] for b in snap["blocking"]}
+    assert {"time.sleep", "queue.get", "queue.put", "custom.rpc"} <= kinds
+    for b in snap["blocking"]:
+        assert "probeL" in b["held"]
+    assert snap["dispatch"] and snap["dispatch"][0]["what"] == "fixture/step"
+    assert "probeL" in snap["dispatch"][0]["held"]
+
+    doc = ca.report(runtime=True, paths=_SMALL_STATIC, base_dir=REPO)
+    names = ca.finding_names(doc)
+    assert any(n.startswith("CN002:time.sleep:") for n in names)
+    assert any(n.startswith("CN003:fixture/step:") for n in names)
+
+
+def test_condition_wait_exempts_own_lock(armed):
+    """`with cond: cond.wait()` is THE pattern — no finding. The same
+    wait with ANOTHER traced lock still held is CN002."""
+    outer = locktrace.traced_lock("cvOuter")
+    cond = threading.Condition()  # raw: constructed from tests/, unwrapped
+
+    def waker():
+        time.sleep(0.05)
+        with cond:
+            cond.notify_all()
+
+    t = threading.Thread(target=waker, name="dl4j-cv-waker")
+    t.start()
+    with cond:
+        cond.wait(2)
+    t.join(10)
+    assert all(b["kind"] != "condition.wait"
+               for b in locktrace.snapshot()["blocking"])
+
+    t = threading.Thread(target=waker, name="dl4j-cv-waker2")
+    t.start()
+    with outer:
+        with cond:
+            cond.wait(2)
+    t.join(10)
+    waits = [b for b in locktrace.snapshot()["blocking"]
+             if b["kind"] == "condition.wait"]
+    assert waits and "cvOuter" in waits[0]["held"]
+
+
+# -- the off-path contract ----------------------------------------------------
+
+def test_uninstrumented_paths_under_10us_per_call():
+    assert not locktrace.enabled()
+    assert threading.Lock is locktrace._ORIG["Lock"]
+    assert time.sleep is locktrace._ORIG["sleep"]
+    calls = 20_000
+    lk = threading.Lock()
+
+    def acquire_release():
+        lk.acquire()
+        lk.release()
+
+    for fn in (acquire_release,
+               lambda: locktrace.note_dispatch("off"),
+               lambda: locktrace.note_blocking("off")):
+        t0 = time.perf_counter()
+        for _ in range(calls):
+            fn()
+        per_call = (time.perf_counter() - t0) / calls
+        assert per_call < 10e-6, f"{fn}: {per_call * 1e6:.2f}µs/call"
+
+
+def test_uninstall_restores_stdlib():
+    locktrace.install()
+    assert threading.Lock is not locktrace._ORIG["Lock"]
+    traced = threading.Condition  # patched factory while armed
+    assert traced is not locktrace._ORIG["Condition"]
+    locktrace.uninstall()
+    assert threading.Lock is locktrace._ORIG["Lock"]
+    assert threading.RLock is locktrace._ORIG["RLock"]
+    assert threading.Condition is locktrace._ORIG["Condition"]
+    assert time.sleep is locktrace._ORIG["sleep"]
+    assert queue.Queue.get is locktrace._ORIG["queue_get"]
+    assert threading.Event.wait is locktrace._ORIG["event_wait"]
+    with pytest.raises(RuntimeError):
+        locktrace.traced_lock("late")
+
+
+def test_lockcheck_on_fit_is_bit_identical():
+    """Arming the sanitizer must not change training numerics: same
+    seed, same data, same score with and without DL4J_LOCKCHECK."""
+    from deeplearning4j_tpu.nn.conf import (
+        DenseLayer,
+        NeuralNetConfiguration,
+        OutputLayer,
+        Updater,
+    )
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal((64, 4)).astype(np.float32)
+    y = np.zeros((64, 2), np.float32)
+    y[np.arange(64), (x.sum(axis=1) > 0).astype(int)] = 1
+
+    def fit_once():
+        conf = (NeuralNetConfiguration.builder()
+                .seed(42).updater(Updater.SGD).learning_rate(0.1).list()
+                .layer(DenseLayer(n_in=4, n_out=8, activation="tanh"))
+                .layer(OutputLayer(n_in=8, n_out=2, activation="softmax",
+                                   loss="mcxent"))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        net.fit(x, y, epochs=2, batch_size=32, async_prefetch=False)
+        return net.score(x, y)
+
+    baseline = fit_once()
+    locktrace.install()
+    try:
+        checked = fit_once()
+    finally:
+        locktrace.uninstall()
+    assert baseline == pytest.approx(checked, abs=1e-9)
+
+
+# -- lexical half: CC005 call form + static CN002/CN003 -----------------------
+
+_SRC_ACQUIRE_CYCLE = """\
+import threading
+
+class S:
+    def __init__(self):
+        self.a_lock = threading.Lock()
+        self.b_lock = threading.Lock()
+
+    def one(self):
+        self.a_lock.acquire()
+        try:
+            with self.b_lock:
+                pass
+        finally:
+            self.a_lock.release()
+
+    def two(self):
+        self.b_lock.acquire()
+        try:
+            self.a_lock.acquire()
+            try:
+                pass
+            finally:
+                self.a_lock.release()
+        finally:
+            self.b_lock.release()
+"""
+
+_SRC_COND = """\
+import threading
+
+class W:
+    def __init__(self):
+        self.state_lock = threading.Lock()
+        self.cv = threading.Condition()
+        self._step_fn = None
+
+    def bad_wait(self):
+        with self.state_lock:
+            with self.cv:
+                self.cv.wait()
+
+    def good_wait(self):
+        with self.cv:
+            self.cv.wait()
+
+    def bad_dispatch(self, x):
+        with self.state_lock:
+            return self._step_fn(x)
+
+    def bad_sleep(self):
+        self.state_lock.acquire()
+        try:
+            import time
+            time.sleep(1.0)
+        finally:
+            self.state_lock.release()
+"""
+
+
+def test_lint_acquire_release_form_feeds_cc005(tmp_path):
+    """The PR's CC005 false-negative fix: reversed order expressed via
+    acquire()/try/finally — invisible to the `with` pass before — is a
+    lock-order cycle."""
+    p = tmp_path / "mod_cycle.py"
+    p.write_text(_SRC_ACQUIRE_CYCLE)
+    findings = lint.lint_paths([str(p)], base_dir=str(tmp_path))
+    cc5 = [f for f in findings if f.code == "CC005"]
+    assert len(cc5) == 1
+    assert "S.a_lock" in cc5[0].name and "S.b_lock" in cc5[0].name
+
+
+def test_lint_static_cn002_cn003(tmp_path):
+    p = tmp_path / "mod_cond.py"
+    p.write_text(_SRC_COND)
+    findings = lint.lint_paths([str(p)], base_dir=str(tmp_path))
+    cn2 = [f for f in findings if f.code == "CN002"]
+    # bad_wait (condition.wait with W.mu still held) + bad_sleep
+    # (time.sleep inside the acquire/finally scope); good_wait exempt
+    assert len(cn2) == 2
+    msgs = " | ".join(f.message for f in cn2)
+    assert "condition.wait" in msgs and "time.sleep" in msgs
+    assert "W.state_lock" in msgs
+    cn3 = [f for f in findings if f.code == "CN003"]
+    assert len(cn3) == 1 and "_step_fn" in cn3[0].message
+    # the construction sites were mapped to lexical keys for the join
+    _, edges, ctor_sites = lint.collect([str(p)], base_dir=str(tmp_path))
+    assert "W.state_lock" in ctor_sites.values() and "W.cv" in ctor_sites.values()
+
+
+def test_merged_edges_origin_labels():
+    static = {("S.a", "S.b"): "m.py:12", ("S.b", "S.c"): "m.py:20"}
+    snap = {"enabled": True, "locks": {}, "blocking": [], "dispatch": [],
+            "edges": [
+                {"src": "m.py:5", "dst": "m.py:6", "count": 2,
+                 "thread": "t0", "witness": ["m.py:12 in one"]},
+                {"src": "m.py:6", "dst": "helper.py:9", "count": 1,
+                 "thread": "t1", "witness": []},
+            ]}
+    ctor = {"m.py:5": "S.a", "m.py:6": "S.b"}
+    merged = ca.merged_edges(static, snap, ctor)
+    assert merged[("S.a", "S.b")]["origin"] == "both"
+    assert merged[("S.a", "S.b")]["count"] == 2
+    assert merged[("S.b", "helper.py:9")]["origin"] == "runtime"
+    assert merged[("S.b", "S.c")]["origin"] == "static"
+
+
+# -- gate semantics -----------------------------------------------------------
+
+def test_baseline_gate_red_then_green(armed, tmp_path, capsys):
+    lk = locktrace.traced_lock("gateL")
+    with lk:
+        time.sleep(0.001)
+    doc = ca.report(runtime=True, paths=_SMALL_STATIC, base_dir=REPO)
+    names = [n for n in ca.finding_names(doc) if n.startswith("CN002:")]
+    assert names
+
+    empty = tmp_path / "empty_baseline.txt"
+    empty.write_text("# nothing allowed\n")
+    rc = ca.main(["--quiet", "--baseline", str(empty)] + _SMALL_STATIC)
+    assert rc == 1
+    err = capsys.readouterr().err
+    assert "LOCK AUDIT REGRESSIONS" in err and names[0] in err
+
+    allowed = tmp_path / "baseline.txt"
+    allowed.write_text("# fixture sleep, exercised on purpose\n"
+                       + "".join(n + "\n" for n in names))
+    rc = ca.main(["--quiet", "--baseline", str(allowed)] + _SMALL_STATIC)
+    assert rc == 0
+
+
+def test_cli_locks_static_only(capsys):
+    """`cli locks` without the sanitizer armed: static half over the
+    repo, which the committed tree keeps clean."""
+    from deeplearning4j_tpu.cli import main as cli_main
+
+    assert cli_main(["locks"]) == 0
+    out = capsys.readouterr().out
+    assert "lock audit:" in out and "runtime=off" in out
